@@ -1,0 +1,21 @@
+"""cadence_tpu: a TPU-native workflow-history replay framework.
+
+A ground-up reimplementation of the capabilities of the reference workflow
+orchestration engine (Uber Cadence, mounted read-only at /root/reference),
+designed TPU-first: the per-workflow replay loop
+(historyEngineImpl → stateBuilder → mutableStateBuilder) becomes a batched
+state-machine transition kernel in JAX that replays millions of workflow
+histories in lockstep across TPU cores, with checksum parity against a
+Python semantic oracle.
+
+Layout:
+  core/      enums, event model, canonical checksum
+  oracle/    single-workflow Python reference replayer (semantic oracle)
+  ops/       dense state layout, event encoder, JAX scan replay kernel
+  parallel/  device mesh, shardings, collectives
+  engine/    host-side control plane (shards, queues, matching, frontend)
+  gen/       golden corpus generators (BASELINE workload suites)
+  native/    C++ host components (batch packing, CRC)
+"""
+
+__version__ = "0.1.0"

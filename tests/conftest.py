@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Sharding tests run on a virtual 8-device CPU mesh: the env vars must be set
+before jax initializes its backends, so they are set here at conftest import
+time (pytest imports conftest before test modules import jax).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_DEVICE_FLAG = "--xla_force_host_platform_device_count=8"
+if _DEVICE_FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _DEVICE_FLAG).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

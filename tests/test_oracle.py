@@ -1,0 +1,412 @@
+"""Oracle replayer unit tests: hand-built fixtures checking the semantics
+mirrored from the reference (state_builder_test.go / mutable_state_builder_test.go
+scenarios, rebuilt by hand — not ported)."""
+import pytest
+
+from cadence_tpu.core.checksum import Checksum, payload_row, verify
+from cadence_tpu.core.enums import (
+    EMPTY_EVENT_ID,
+    EventType,
+    CloseStatus,
+    TimeoutType,
+    TimerTaskType,
+    TransferTaskType,
+    WorkflowState,
+)
+from cadence_tpu.core.events import HistoryBatch, HistoryEvent, RetryPolicy
+from cadence_tpu.gen.corpus import SUITES, HistoryWriter, generate_history
+from cadence_tpu.oracle.mutable_state import ReplayError
+from cadence_tpu.oracle.state_builder import StateBuilder
+
+
+def make_batch(events, wf="wf-1", run="run-1", new_run_events=None):
+    return HistoryBatch(
+        domain_id="dom-1", workflow_id=wf, run_id=run, events=events,
+        new_run_events=new_run_events,
+    )
+
+
+def ev(eid, etype, ts=1_000_000_000, version=0, task_id=0, **attrs):
+    return HistoryEvent(id=eid, event_type=etype, version=version,
+                        timestamp=ts, task_id=task_id, attrs=attrs)
+
+
+class TestStartAndDecision:
+    def test_started_initializes_execution_info(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=60,
+               task_start_to_close_timeout_seconds=10),
+        ]))
+        info = sb.ms.execution_info
+        assert info.state == WorkflowState.Created
+        assert info.close_status == CloseStatus.Nothing
+        assert info.workflow_id == "wf-1"
+        assert info.run_id == "run-1"
+        assert info.workflow_timeout == 60
+        assert info.decision_start_to_close_timeout == 10
+        assert info.last_processed_event == EMPTY_EVENT_ID
+        assert info.last_first_event_id == 1
+        assert info.next_event_id == 2
+        assert info.decision_schedule_id == EMPTY_EVENT_ID
+        # start tasks: RecordWorkflowStarted transfer + WorkflowTimeout timer
+        kinds = [(t.kind, t.task_type) for t in sb.ms.transfer_tasks + sb.ms.timer_tasks]
+        assert ("transfer", TransferTaskType.RecordWorkflowStarted) in kinds
+        assert ("timer", TimerTaskType.WorkflowTimeout) in kinds
+
+    def test_decision_cycle(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=60,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        info = sb.ms.execution_info
+        assert info.state == WorkflowState.Running  # scheduled sets Running
+        assert info.decision_schedule_id == 2
+        assert info.decision_started_id == EMPTY_EVENT_ID
+
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, scheduled_event_id=2,
+               request_id="r1"),
+        ]))
+        assert info.decision_started_id == 3
+
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+        ]))
+        assert info.decision_schedule_id == EMPTY_EVENT_ID
+        assert info.decision_started_id == EMPTY_EVENT_ID
+        assert info.decision_attempt == 0
+        assert info.last_processed_event == 3
+        assert info.next_event_id == 5
+        # decision transfer task was generated on schedule
+        dts = [t for t in sb.ms.transfer_tasks
+               if t.task_type == TransferTaskType.DecisionTask]
+        assert len(dts) == 1 and dts[0].event_id == 2
+        # decision start-to-close timer generated on start
+        timers = [t for t in sb.ms.timer_tasks
+                  if t.task_type == TimerTaskType.DecisionTimeout]
+        assert len(timers) == 1
+        assert timers[0].timeout_type == TimeoutType.StartToClose
+
+    def test_decision_failed_increments_attempt_and_transient_decision(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=60,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, scheduled_event_id=2,
+               request_id="r1"),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskFailed, scheduled_event_id=2,
+               started_event_id=3),
+        ]))
+        info = sb.ms.execution_info
+        # FailDecision(increment=True) then ReplicateTransientDecisionTaskScheduled:
+        # attempt was 0 before fail -> 1; transient decision created with
+        # schedule ID == next event ID from previous batch end (4)
+        assert info.decision_attempt == 1
+        assert info.decision_schedule_id == 4
+        assert info.decision_started_id == EMPTY_EVENT_ID
+
+    def test_decision_timed_out_schedule_to_start(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=60,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskTimedOut, scheduled_event_id=2,
+               timeout_type=int(TimeoutType.ScheduleToStart)),
+        ]))
+        # non-sticky => attempt increments; transient decision at next id (3)
+        info = sb.ms.execution_info
+        assert info.decision_attempt == 1
+        assert info.decision_schedule_id == 3
+
+
+class TestActivitiesTimers:
+    def _started_wf(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, scheduled_event_id=2, request_id="r"),
+        ]))
+        return sb
+
+    def test_activity_lifecycle(self):
+        sb = self._started_wf()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+            ev(5, EventType.ActivityTaskScheduled, activity_id="a1",
+               task_list="tl", schedule_to_start_timeout_seconds=10,
+               schedule_to_close_timeout_seconds=20,
+               start_to_close_timeout_seconds=15, heartbeat_timeout_seconds=0),
+        ]))
+        assert 5 in sb.ms.pending_activity_info_ids
+        ai = sb.ms.pending_activity_info_ids[5]
+        assert ai.started_id == EMPTY_EVENT_ID
+        assert ai.scheduled_event_batch_id == 4
+        # ActivityTask transfer generated
+        assert any(t.task_type == TransferTaskType.ActivityTask and t.event_id == 5
+                   for t in sb.ms.transfer_tasks)
+        # activity timer generated at end of batch: schedule-to-start is nearest
+        at = [t for t in sb.ms.timer_tasks
+              if t.task_type == TimerTaskType.ActivityTimeout]
+        assert len(at) == 1 and at[0].timeout_type == TimeoutType.ScheduleToStart
+
+        sb.apply_batch(make_batch([
+            ev(6, EventType.ActivityTaskStarted, scheduled_event_id=5,
+               request_id="ar", ts=2_000_000_000),
+        ]))
+        assert sb.ms.pending_activity_info_ids[5].started_id == 6
+
+        sb.apply_batch(make_batch([
+            ev(7, EventType.ActivityTaskCompleted, scheduled_event_id=5,
+               started_event_id=6),
+        ]))
+        assert 5 not in sb.ms.pending_activity_info_ids
+        assert "a1" not in sb.ms.pending_activity_id_to_event_id
+
+    def test_activity_cancel_requested_unknown_id_tolerated(self):
+        sb = self._started_wf()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.ActivityTaskCancelRequested, activity_id="nope"),
+        ]))  # must not raise (mutable_state_builder.go:2451-2454)
+
+    def test_activity_complete_missing_raises(self):
+        sb = self._started_wf()
+        with pytest.raises(ReplayError):
+            sb.apply_batch(make_batch([
+                ev(4, EventType.ActivityTaskCompleted, scheduled_event_id=99,
+                   started_event_id=98),
+            ]))
+
+    def test_timer_lifecycle(self):
+        sb = self._started_wf()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+            ev(5, EventType.TimerStarted, timer_id="t1",
+               start_to_fire_timeout_seconds=30),
+        ]))
+        assert "t1" in sb.ms.pending_timer_info_ids
+        ti = sb.ms.pending_timer_info_ids["t1"]
+        assert ti.started_id == 5
+        # user timer task generated at batch end
+        ut = [t for t in sb.ms.timer_tasks if t.task_type == TimerTaskType.UserTimer]
+        assert len(ut) == 1 and ut[0].event_id == 5
+        assert ut[0].visibility_timestamp == ti.expiry_time
+
+        sb.apply_batch(make_batch([
+            ev(6, EventType.TimerFired, timer_id="t1", started_event_id=5),
+        ]))
+        assert "t1" not in sb.ms.pending_timer_info_ids
+        assert 5 not in sb.ms.pending_timer_event_id_to_id
+
+
+class TestCloseAndSignals:
+    def _running(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, scheduled_event_id=2, request_id="r"),
+        ]))
+        return sb
+
+    def test_signal_increments_count(self):
+        sb = self._running()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.WorkflowExecutionSignaled, signal_name="s"),
+            ev(5, EventType.WorkflowExecutionSignaled, signal_name="s"),
+        ]))
+        assert sb.ms.execution_info.signal_count == 2
+
+    def test_cancel_requested_flag(self):
+        sb = self._running()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.WorkflowExecutionCancelRequested, cause="x"),
+        ]))
+        assert sb.ms.execution_info.cancel_requested is True
+
+    def test_complete_workflow(self):
+        sb = self._running()
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+            ev(5, EventType.WorkflowExecutionCompleted,
+               decision_task_completed_event_id=4),
+        ]))
+        info = sb.ms.execution_info
+        assert info.state == WorkflowState.Completed
+        assert info.close_status == CloseStatus.Completed
+        assert info.completion_event_batch_id == 4
+        assert any(t.task_type == TransferTaskType.CloseExecution
+                   for t in sb.ms.transfer_tasks)
+        assert any(t.task_type == TimerTaskType.DeleteHistoryEvent
+                   for t in sb.ms.timer_tasks)
+
+    def test_invalid_close_from_created_raises(self):
+        sb = StateBuilder()
+        with pytest.raises(ReplayError):
+            sb.apply_batch(make_batch([
+                ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+                   workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+                   task_start_to_close_timeout_seconds=10),
+                # Completed-with-Completed-status is invalid from Created
+                # (workflowExecutionInfo.go:65-70 allows only terminated/
+                # timedout/continuedasnew from Created)
+                ev(2, EventType.WorkflowExecutionCompleted,
+                   decision_task_completed_event_id=1),
+            ]))
+
+    def test_continue_as_new(self):
+        sb = self._running()
+        new_run = [
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10, ts=9_000_000_000),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0, ts=9_000_000_100),
+        ]
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+            ev(5, EventType.WorkflowExecutionContinuedAsNew,
+               new_execution_run_id="run-2",
+               decision_task_completed_event_id=4),
+        ], new_run_events=new_run))
+        assert sb.ms.execution_info.close_status == CloseStatus.ContinuedAsNew
+        assert sb.new_run_state is not None
+        assert sb.new_run_state.execution_info.run_id == "run-2"
+        assert sb.new_run_state.execution_info.decision_schedule_id == 2
+
+
+class TestVersionHistories:
+    def test_version_bump_appends_item(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, version=1, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, version=1, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, version=2, scheduled_event_id=2,
+               request_id="r"),
+        ]))
+        items = sb.ms.version_histories.current().items
+        assert [(i.event_id, i.version) for i in items] == [(2, 1), (3, 2)]
+        assert sb.ms.current_version == 2
+
+    def test_lower_version_rejected(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, version=5, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+        ]))
+        with pytest.raises(ReplayError):
+            sb.apply_batch(make_batch([
+                ev(2, EventType.DecisionTaskScheduled, version=4, task_list="tl",
+                   start_to_close_timeout_seconds=10, attempt=0),
+            ]))
+
+
+class TestChecksum:
+    def test_checksum_roundtrip(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        csum = Checksum.of(sb.ms)
+        verify(sb.ms, csum)  # no raise
+        sb.ms.execution_info.signal_count += 1
+        with pytest.raises(ValueError):
+            verify(sb.ms, csum)
+
+    def test_payload_row_sorted_ids(self):
+        sb = StateBuilder()
+        sb.apply_batch(make_batch([
+            ev(1, EventType.WorkflowExecutionStarted, task_list="tl",
+               workflow_type="wt", execution_start_to_close_timeout_seconds=600,
+               task_start_to_close_timeout_seconds=10),
+            ev(2, EventType.DecisionTaskScheduled, task_list="tl",
+               start_to_close_timeout_seconds=10, attempt=0),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(3, EventType.DecisionTaskStarted, scheduled_event_id=2, request_id="r"),
+        ]))
+        sb.apply_batch(make_batch([
+            ev(4, EventType.DecisionTaskCompleted, scheduled_event_id=2,
+               started_event_id=3),
+            ev(5, EventType.ActivityTaskScheduled, activity_id="a1", task_list="tl",
+               schedule_to_start_timeout_seconds=5,
+               schedule_to_close_timeout_seconds=10,
+               start_to_close_timeout_seconds=5, heartbeat_timeout_seconds=0),
+            ev(6, EventType.ActivityTaskScheduled, activity_id="a2", task_list="tl",
+               schedule_to_start_timeout_seconds=5,
+               schedule_to_close_timeout_seconds=10,
+               start_to_close_timeout_seconds=5, heartbeat_timeout_seconds=0),
+        ]))
+        row = payload_row(sb.ms)
+        # activity list block: count 2 then ids 5, 6
+        # offsets: 11 scalars, 1+16 version history, 1+16 timers => activity
+        # count at 11 + 17 + 17 = 45
+        assert row[45] == 2
+        assert row[46] == 5 and row[47] == 6
+
+
+class TestCorpusReplay:
+    """All generated corpora replay cleanly through the oracle."""
+
+    @pytest.mark.parametrize("suite", SUITES)
+    def test_suite_replays(self, suite):
+        for i in range(8):
+            batches = generate_history(suite, seed=7, workflow_index=i,
+                                       target_events=100)
+            sb = StateBuilder()
+            sb.replay_history(batches)
+            info = sb.ms.execution_info
+            assert info.state == WorkflowState.Completed
+            assert info.next_event_id == batches[-1].events[-1].id + 1
+            Checksum.of(sb.ms)  # payload within layout capacities
+
+    @pytest.mark.parametrize("suite", SUITES)
+    def test_determinism(self, suite):
+        a = generate_history(suite, seed=3, workflow_index=2)
+        b = generate_history(suite, seed=3, workflow_index=2)
+        ra = StateBuilder().replay_history(a)
+        rb = StateBuilder().replay_history(b)
+        assert (payload_row(ra) == payload_row(rb)).all()
