@@ -1,0 +1,9 @@
+"""Device-side replay: dense state layout, event encoding, transition kernels.
+
+64-bit mode is required: event timestamps are unix nanoseconds and the
+checksum payload is defined over int64 lanes. This must run before any jax
+arrays are created, which importing this package guarantees for all ops users.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

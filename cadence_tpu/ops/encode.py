@@ -1,0 +1,161 @@
+"""Host-side event packing: histories → dense [W, E, L] int64 lane tensors.
+
+The reference decodes thriftrw/JSON event blobs into Go structs per event
+(common/persistence/serialization/serializer.go); here batches are packed
+into a fixed lane schema the device kernel can scan. String identifiers
+(activity IDs, timer IDs) are interned to dense per-workflow integer keys —
+state transitions only ever compare them for equality
+(state_builder.go:132-646 uses no payload bytes), so payloads stay host-side.
+
+This pure-Python packer is the reference implementation; the C++ packer in
+native/ implements the same schema for production feed rates.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.enums import EventType
+from ..core.events import HistoryBatch
+
+# Lane indices
+LANE_EVENT_ID = 0    # 0 = padding row
+LANE_EVENT_TYPE = 1  # EventType value; -1 on padding
+LANE_VERSION = 2
+LANE_TIMESTAMP = 3
+LANE_TASK_ID = 4
+LANE_BATCH_FIRST = 5  # first event ID of the enclosing batch
+LANE_BATCH_LAST = 6   # 1 if this is the last event of its batch
+LANE_A0 = 7
+NUM_ATTR_LANES = 8
+NUM_LANES = LANE_A0 + NUM_ATTR_LANES  # 15
+
+
+class _Interner:
+    """Per-workflow string → dense int key (starting at 1; 0 = absent)."""
+
+    def __init__(self) -> None:
+        self._map: Dict[str, int] = {}
+
+    def key(self, s: str) -> int:
+        if s not in self._map:
+            self._map[s] = len(self._map) + 1
+        return self._map[s]
+
+
+def _encode_attrs(ev, interner: _Interner) -> List[int]:
+    """Per-type attribute lanes a0..a7. Must stay in lockstep with
+    transitions.py's lane reads."""
+    a = [0] * NUM_ATTR_LANES
+    et = ev.event_type
+    g = ev.get
+
+    if et == EventType.WorkflowExecutionStarted:
+        a[0] = g("execution_start_to_close_timeout_seconds", 0) or 0
+        a[1] = g("task_start_to_close_timeout_seconds", 0) or 0
+        a[2] = g("first_decision_task_backoff_seconds", 0) or 0
+        a[3] = g("attempt", 0) or 0
+        a[4] = g("expiration_timestamp", 0) or 0
+        a[5] = 1 if g("parent_workflow_id") else 0
+        a[6] = 1 if g("retry_policy") is not None else 0
+        initiator = g("initiator")
+        a[7] = -1 if initiator is None else int(initiator)
+    elif et == EventType.DecisionTaskScheduled:
+        a[0] = g("start_to_close_timeout_seconds", 0) or 0
+        a[1] = g("attempt", 0) or 0
+    elif et == EventType.DecisionTaskStarted:
+        a[0] = g("scheduled_event_id", 0)
+    elif et == EventType.DecisionTaskCompleted:
+        a[0] = g("scheduled_event_id", 0)
+        a[1] = g("started_event_id", 0)
+    elif et == EventType.DecisionTaskTimedOut:
+        a[0] = int(g("timeout_type", 0))
+    elif et == EventType.ActivityTaskScheduled:
+        a[0] = interner.key("act:" + g("activity_id", ""))
+        a[1] = g("schedule_to_start_timeout_seconds", 0) or 0
+        a[2] = g("schedule_to_close_timeout_seconds", 0) or 0
+        a[3] = g("start_to_close_timeout_seconds", 0) or 0
+        a[4] = g("heartbeat_timeout_seconds", 0) or 0
+        retry = g("retry_policy")
+        a[5] = 1 if retry is not None else 0
+        a[6] = retry.expiration_interval_seconds if retry is not None else 0
+    elif et == EventType.ActivityTaskStarted:
+        a[0] = g("scheduled_event_id", 0)
+    elif et in (
+        EventType.ActivityTaskCompleted,
+        EventType.ActivityTaskFailed,
+        EventType.ActivityTaskTimedOut,
+        EventType.ActivityTaskCanceled,
+    ):
+        a[0] = g("scheduled_event_id", 0)
+    elif et == EventType.ActivityTaskCancelRequested:
+        a[0] = interner.key("act:" + g("activity_id", ""))
+    elif et == EventType.TimerStarted:
+        a[0] = interner.key("timer:" + g("timer_id", ""))
+        a[1] = g("start_to_fire_timeout_seconds", 0) or 0
+    elif et in (EventType.TimerFired, EventType.TimerCanceled):
+        a[0] = interner.key("timer:" + g("timer_id", ""))
+    elif et == EventType.ChildWorkflowExecutionStarted:
+        a[0] = g("initiated_event_id", 0)
+    elif et in (
+        EventType.StartChildWorkflowExecutionFailed,
+        EventType.ChildWorkflowExecutionCompleted,
+        EventType.ChildWorkflowExecutionFailed,
+        EventType.ChildWorkflowExecutionCanceled,
+        EventType.ChildWorkflowExecutionTimedOut,
+        EventType.ChildWorkflowExecutionTerminated,
+    ):
+        a[0] = g("initiated_event_id", 0)
+    elif et in (
+        EventType.RequestCancelExternalWorkflowExecutionFailed,
+        EventType.ExternalWorkflowExecutionCancelRequested,
+        EventType.SignalExternalWorkflowExecutionFailed,
+        EventType.ExternalWorkflowExecutionSignaled,
+    ):
+        a[0] = g("initiated_event_id", 0)
+    # remaining types carry no state-relevant attributes
+    return a
+
+
+def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarray:
+    """Pack one workflow's batched history into [E, L] lanes (zero-padded)."""
+    out = np.zeros((max_events, NUM_LANES), dtype=np.int64)
+    out[:, LANE_EVENT_TYPE] = -1
+    interner = _Interner()
+    row = 0
+    for batch in batches:
+        if batch.new_run_events:
+            # continued-as-new chains are split host-side: the caller must
+            # append the new run as its own workflow row (the device kernel
+            # replays runs, not chains). Loud failure beats silent drop.
+            raise ValueError(
+                "batch carries new_run_events; split the continued-as-new "
+                "run into its own workflow row before encoding"
+            )
+        first_id = batch.events[0].id
+        for j, ev in enumerate(batch.events):
+            if row >= max_events:
+                raise OverflowError(
+                    f"history has more than {max_events} events"
+                )
+            out[row, LANE_EVENT_ID] = ev.id
+            out[row, LANE_EVENT_TYPE] = int(ev.event_type)
+            out[row, LANE_VERSION] = ev.version
+            out[row, LANE_TIMESTAMP] = ev.timestamp
+            out[row, LANE_TASK_ID] = ev.task_id
+            out[row, LANE_BATCH_FIRST] = first_id
+            out[row, LANE_BATCH_LAST] = 1 if j == len(batch.events) - 1 else 0
+            out[row, LANE_A0:] = _encode_attrs(ev, interner)
+            row += 1
+    return out
+
+
+def encode_corpus(histories: Sequence[Sequence[HistoryBatch]],
+                  max_events: int = 0) -> np.ndarray:
+    """Pack a corpus into [W, E, L]; E = max history length (or `max_events`)."""
+    if max_events <= 0:
+        max_events = max(
+            sum(len(b.events) for b in h) for h in histories
+        )
+    return np.stack([encode_history(h, max_events) for h in histories])

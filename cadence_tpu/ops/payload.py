@@ -1,0 +1,66 @@
+"""Device-side canonical checksum payload assembly.
+
+Produces, from the dense ReplayState, exactly the same [W, width] int64
+payload matrix as the oracle's core/checksum.payload_row (field order per
+reference checksum.go:56-113). Pending-ID lists are sorted on device with
+jnp.sort — the PAD sentinel is positive-huge, so unoccupied slots sort to
+the tail, matching the oracle's [sorted reals..., PAD...] layout.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.checksum import DEFAULT_LAYOUT, PAD, PayloadLayout
+from ..core.checksum import fnv64 as _fnv64  # noqa: F401 (sticky always empty → 0)
+from .state import ReplayState
+
+
+def _sorted_ids(occ: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sort(jnp.where(occ, ids, jnp.int64(PAD)), axis=1)
+
+
+def _count(occ: jnp.ndarray) -> jnp.ndarray:
+    return occ.sum(axis=1).astype(jnp.int64)
+
+
+def payload_rows(s: ReplayState, layout: PayloadLayout = DEFAULT_LAYOUT) -> jnp.ndarray:
+    """[W, layout.width] int64 canonical payload, comparable elementwise with
+    the oracle's payload_row output."""
+    W = s.state.shape[0]
+    Kv = layout.max_version_history_items
+    scalars = jnp.stack(
+        [
+            s.cancel_requested.astype(jnp.int64),
+            s.state.astype(jnp.int64),
+            s.last_first_event_id,
+            s.next_event_id,
+            s.last_processed_event,
+            s.signal_count,
+            s.decision_attempt,
+            s.decision_schedule_id,
+            s.decision_started_id,
+            s.decision_version,
+            jnp.zeros((W,), jnp.int64),  # sticky cleared on replay → hash 0
+        ],
+        axis=1,
+    )
+    # interleave (event_id, version) pairs; slots beyond vh_count are PAD-filled
+    vh_pairs = jnp.stack([s.vh_event_ids, s.vh_versions], axis=2).reshape(W, 2 * Kv)
+    parts = [
+        scalars,
+        s.vh_count.astype(jnp.int64)[:, None],
+        vh_pairs,
+        _count(s.timers.occ)[:, None],
+        _sorted_ids(s.timers.occ, s.timers.started_id),
+        _count(s.activities.occ)[:, None],
+        _sorted_ids(s.activities.occ, s.activities.schedule_id),
+        _count(s.children.occ)[:, None],
+        _sorted_ids(s.children.occ, s.children.initiated_id),
+        _count(s.signals.occ)[:, None],
+        _sorted_ids(s.signals.occ, s.signals.initiated_id),
+        _count(s.cancels.occ)[:, None],
+        _sorted_ids(s.cancels.occ, s.cancels.initiated_id),
+    ]
+    rows = jnp.concatenate(parts, axis=1)
+    assert rows.shape[1] == layout.width, (rows.shape, layout.width)
+    return rows

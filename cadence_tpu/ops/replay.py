@@ -1,0 +1,60 @@
+"""The batched replay kernel: scan the event axis, one lockstep step per event.
+
+This is the TPU reframing of the reference's replay call stack
+(historyEngine.ReplicateEventsV2 → stateBuilder.ApplyEvents →
+Replicate*Event; see SURVEY.md §3.5): instead of one Go goroutine replaying
+one workflow's events in a loop, a single jitted `lax.scan` applies event i
+of every workflow's (padded) history to all W workflows at once. Sequence
+axis = scan (state transitions are inherently sequential per workflow);
+workflow axis = vectorization + sharding (parallel/mesh.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout, crc32_of_rows
+from ..core.events import HistoryBatch
+from .encode import encode_corpus
+from .payload import payload_rows
+from .state import ReplayState, init_state
+from .transitions import step
+
+
+def _scan_body(s: ReplayState, ev: jnp.ndarray) -> Tuple[ReplayState, None]:
+    return step(s, ev), None
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def replay_events(events: jnp.ndarray,
+                  layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:
+    """Replay packed events [W, E, L] from a fresh state; returns final state."""
+    s0 = init_state(events.shape[0], layout)
+    # scan over the event axis: xs must be [E, W, L]
+    s, _ = jax.lax.scan(_scan_body, s0, jnp.swapaxes(events, 0, 1))
+    return s
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def replay_to_payload(events: jnp.ndarray,
+                      layout: PayloadLayout = DEFAULT_LAYOUT
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Replay and reduce to (canonical payload rows [W, width], error [W])."""
+    s = replay_events(events, layout)
+    return payload_rows(s, layout), s.error
+
+
+def replay_corpus(histories: Sequence[Sequence[HistoryBatch]],
+                  layout: PayloadLayout = DEFAULT_LAYOUT,
+                  max_events: int = 0,
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host helper: encode histories, replay on the default backend, and
+    return (payload_rows, crc32s, errors) as numpy arrays."""
+    events = encode_corpus(histories, max_events)
+    rows, errors = replay_to_payload(jnp.asarray(events), layout)
+    rows_np = np.asarray(rows)
+    return rows_np, crc32_of_rows(rows_np), np.asarray(errors)

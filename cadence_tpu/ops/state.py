@@ -1,0 +1,231 @@
+"""Dense replay state: the TPU-resident twin of the oracle's MutableState.
+
+The reference keeps per-workflow mutable state as Go maps and structs
+(mutable_state_builder.go:83-172). Here every field is a struct-of-arrays
+tensor over the workflow axis W, so one transition step updates all W
+workflows in lockstep:
+
+- scalars:        [W]       (execution info + decision state + version)
+- pending tables: [W, K]    (activities, timers, children, cancels, signals)
+- version history:[W, Kv]   (event id / version item pairs + count)
+
+Capacities K are fixed (PayloadLayout); overflow sets the per-workflow
+error flag — measured and reported by the caller, never silent (the host
+engine falls back to the oracle replayer for flagged workflows, the analog
+of the reference's per-workflow Go path).
+
+The error flag is sticky: a workflow whose history is invalid freezes its
+state at the first bad event, mirroring the reference's error return from
+ApplyEvents (which aborts that workflow's replay transaction).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..core.checksum import DEFAULT_LAYOUT, PAD, PayloadLayout
+from ..core.enums import EMPTY_EVENT_ID, EMPTY_VERSION, FIRST_EVENT_ID, WorkflowState
+
+I64 = jnp.int64
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+class ActivityTable(NamedTuple):
+    """Pending activities; fields mirror oracle ActivityInfo
+    (persistence ActivityInfo, dataManagerInterfaces.go:752)."""
+
+    occ: jnp.ndarray            # [W, K] bool
+    schedule_id: jnp.ndarray    # [W, K] i64
+    started_id: jnp.ndarray     # [W, K] i64
+    version: jnp.ndarray        # [W, K] i64
+    activity_key: jnp.ndarray   # [W, K] i64 (interned ActivityID)
+    scheduled_time: jnp.ndarray # [W, K] i64 nanos
+    started_time: jnp.ndarray   # [W, K] i64 nanos
+    last_heartbeat: jnp.ndarray # [W, K] i64 nanos
+    sched_to_start: jnp.ndarray # [W, K] i64 seconds
+    sched_to_close: jnp.ndarray # [W, K] i64 seconds
+    start_to_close: jnp.ndarray # [W, K] i64 seconds
+    heartbeat: jnp.ndarray      # [W, K] i64 seconds
+    cancel_requested: jnp.ndarray  # [W, K] bool
+    cancel_request_id: jnp.ndarray # [W, K] i64
+    attempt: jnp.ndarray        # [W, K] i64
+    timer_status: jnp.ndarray   # [W, K] i32 (TIMER_TASK_STATUS_* bitmask)
+    has_retry: jnp.ndarray      # [W, K] bool
+    batch_id: jnp.ndarray       # [W, K] i64 (ScheduledEventBatchID)
+
+
+class TimerTable(NamedTuple):
+    """Pending user timers (TimerInfo, dataManagerInterfaces.go:792)."""
+
+    occ: jnp.ndarray          # [W, K] bool
+    timer_key: jnp.ndarray    # [W, K] i64 (interned TimerID)
+    started_id: jnp.ndarray   # [W, K] i64
+    expiry_time: jnp.ndarray  # [W, K] i64 nanos
+    task_status: jnp.ndarray  # [W, K] i32
+    version: jnp.ndarray      # [W, K] i64
+
+
+class ChildTable(NamedTuple):
+    """Pending child workflows (ChildExecutionInfo, dataManagerInterfaces.go:801)."""
+
+    occ: jnp.ndarray          # [W, K] bool
+    initiated_id: jnp.ndarray # [W, K] i64
+    started_id: jnp.ndarray   # [W, K] i64
+    version: jnp.ndarray      # [W, K] i64
+    batch_id: jnp.ndarray     # [W, K] i64
+
+
+class InitiatedTable(NamedTuple):
+    """Pending external request-cancels / signals (RequestCancelInfo /
+    SignalInfo, dataManagerInterfaces.go:818,:826)."""
+
+    occ: jnp.ndarray          # [W, K] bool
+    initiated_id: jnp.ndarray # [W, K] i64
+    version: jnp.ndarray      # [W, K] i64
+    batch_id: jnp.ndarray     # [W, K] i64
+
+
+class ReplayState(NamedTuple):
+    """All per-workflow state carried through the event scan."""
+
+    # execution info scalars (checksum-relevant first)
+    state: jnp.ndarray                 # [W] i32 WorkflowState
+    close_status: jnp.ndarray          # [W] i32 CloseStatus
+    cancel_requested: jnp.ndarray      # [W] bool
+    last_first_event_id: jnp.ndarray   # [W] i64
+    next_event_id: jnp.ndarray         # [W] i64
+    last_processed_event: jnp.ndarray  # [W] i64
+    signal_count: jnp.ndarray          # [W] i64
+    # decision state (mutable_state_decision_task_manager.go)
+    decision_version: jnp.ndarray      # [W] i64
+    decision_schedule_id: jnp.ndarray  # [W] i64
+    decision_started_id: jnp.ndarray   # [W] i64
+    decision_attempt: jnp.ndarray      # [W] i64
+    decision_timeout: jnp.ndarray      # [W] i64 seconds
+    decision_scheduled_ts: jnp.ndarray # [W] i64 nanos
+    decision_started_ts: jnp.ndarray   # [W] i64 nanos
+    decision_original_scheduled_ts: jnp.ndarray  # [W] i64 nanos
+    # other execution info
+    workflow_timeout: jnp.ndarray      # [W] i64 seconds
+    decision_sts_timeout: jnp.ndarray  # [W] i64 seconds (DecisionStartToCloseTimeout)
+    start_timestamp: jnp.ndarray       # [W] i64 nanos
+    completion_event_batch_id: jnp.ndarray  # [W] i64
+    last_event_task_id: jnp.ndarray    # [W] i64
+    workflow_attempt: jnp.ndarray      # [W] i64
+    expiration_time: jnp.ndarray       # [W] i64 nanos
+    has_parent: jnp.ndarray            # [W] bool
+    # version bookkeeping
+    current_version: jnp.ndarray       # [W] i64
+    vh_event_ids: jnp.ndarray          # [W, Kv] i64 (PAD-filled)
+    vh_versions: jnp.ndarray           # [W, Kv] i64 (PAD-filled)
+    vh_count: jnp.ndarray              # [W] i32
+    # pending tables
+    activities: ActivityTable
+    timers: TimerTable
+    children: ChildTable
+    cancels: InitiatedTable
+    signals: InitiatedTable
+    # sticky error flag (0 = healthy; else ErrorCode of first failure)
+    error: jnp.ndarray                 # [W] i32
+
+
+class ErrorCode:
+    """First-failure codes recorded in ReplayState.error."""
+
+    NONE = 0
+    INVALID_STATE_TRANSITION = 1
+    VERSION_HISTORY_ORDER = 2
+    VERSION_HISTORY_OVERFLOW = 3
+    MISSING_DECISION = 4
+    MISSING_ACTIVITY = 5
+    MISSING_TIMER = 6
+    MISSING_CHILD = 7
+    MISSING_REQUEST_CANCEL = 8
+    MISSING_SIGNAL = 9
+    TABLE_OVERFLOW = 10
+    UNKNOWN_EVENT_TYPE = 11
+
+
+def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:
+    """Fresh state for W workflows, matching the oracle's ExecutionInfo
+    defaults (oracle/mutable_state.py ExecutionInfo / NewMutableStateBuilder)."""
+    W = num_workflows
+
+    def full(shape, value, dtype=I64):
+        return jnp.full(shape, value, dtype=dtype)
+
+    def zeros(shape, dtype=I64):
+        return jnp.zeros(shape, dtype=dtype)
+
+    Ka, Kt = layout.max_activities, layout.max_timers
+    Kc, Kr, Ks = layout.max_children, layout.max_request_cancels, layout.max_signals
+    Kv = layout.max_version_history_items
+
+    activities = ActivityTable(
+        occ=zeros((W, Ka), BOOL),
+        schedule_id=zeros((W, Ka)), started_id=zeros((W, Ka)),
+        version=zeros((W, Ka)), activity_key=zeros((W, Ka)),
+        scheduled_time=zeros((W, Ka)), started_time=zeros((W, Ka)),
+        last_heartbeat=zeros((W, Ka)),
+        sched_to_start=zeros((W, Ka)), sched_to_close=zeros((W, Ka)),
+        start_to_close=zeros((W, Ka)), heartbeat=zeros((W, Ka)),
+        cancel_requested=zeros((W, Ka), BOOL), cancel_request_id=zeros((W, Ka)),
+        attempt=zeros((W, Ka)), timer_status=zeros((W, Ka), I32),
+        has_retry=zeros((W, Ka), BOOL), batch_id=zeros((W, Ka)),
+    )
+    timers = TimerTable(
+        occ=zeros((W, Kt), BOOL), timer_key=zeros((W, Kt)),
+        started_id=zeros((W, Kt)), expiry_time=zeros((W, Kt)),
+        task_status=zeros((W, Kt), I32), version=zeros((W, Kt)),
+    )
+    children = ChildTable(
+        occ=zeros((W, Kc), BOOL), initiated_id=zeros((W, Kc)),
+        started_id=zeros((W, Kc)), version=zeros((W, Kc)),
+        batch_id=zeros((W, Kc)),
+    )
+    cancels = InitiatedTable(
+        occ=zeros((W, Kr), BOOL), initiated_id=zeros((W, Kr)),
+        version=zeros((W, Kr)), batch_id=zeros((W, Kr)),
+    )
+    signals = InitiatedTable(
+        occ=zeros((W, Ks), BOOL), initiated_id=zeros((W, Ks)),
+        version=zeros((W, Ks)), batch_id=zeros((W, Ks)),
+    )
+
+    return ReplayState(
+        state=full((W,), WorkflowState.Created, I32),
+        close_status=zeros((W,), I32),
+        cancel_requested=zeros((W,), BOOL),
+        last_first_event_id=full((W,), FIRST_EVENT_ID),
+        next_event_id=full((W,), FIRST_EVENT_ID),
+        last_processed_event=full((W,), EMPTY_EVENT_ID),
+        signal_count=zeros((W,)),
+        decision_version=full((W,), EMPTY_VERSION),
+        decision_schedule_id=full((W,), EMPTY_EVENT_ID),
+        decision_started_id=full((W,), EMPTY_EVENT_ID),
+        decision_attempt=zeros((W,)),
+        decision_timeout=zeros((W,)),
+        decision_scheduled_ts=zeros((W,)),
+        decision_started_ts=zeros((W,)),
+        decision_original_scheduled_ts=zeros((W,)),
+        workflow_timeout=zeros((W,)),
+        decision_sts_timeout=zeros((W,)),
+        start_timestamp=zeros((W,)),
+        completion_event_batch_id=full((W,), EMPTY_EVENT_ID),
+        last_event_task_id=zeros((W,)),
+        workflow_attempt=zeros((W,)),
+        expiration_time=zeros((W,)),
+        has_parent=zeros((W,), BOOL),
+        current_version=full((W,), EMPTY_VERSION),
+        vh_event_ids=full((W, Kv), PAD),
+        vh_versions=full((W, Kv), PAD),
+        vh_count=zeros((W,), I32),
+        activities=activities,
+        timers=timers,
+        children=children,
+        cancels=cancels,
+        signals=signals,
+        error=zeros((W,), I32),
+    )

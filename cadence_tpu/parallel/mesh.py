@@ -1,0 +1,73 @@
+"""Device mesh + shardings: the TPU analog of the reference's shard fabric.
+
+The reference scales by hashing workflow IDs onto history shards owned by
+hosts via a consistent hashring (common/config/config.go:170-173,
+membership/resolver.go:169, shard/controller.go). Here the same axis —
+"which workflows live where" — is a sharded array dimension: workflows are
+partitioned over the mesh's 'shard' axis and the replay kernel runs SPMD
+with XLA inserting collectives only where results are aggregated (global
+error counts, corpus-level checksums) — those ride ICI within a slice and
+DCN across slices, replacing the reference's gRPC fan-out.
+
+There are no weight tensors in a state-machine engine, so tensor/expert
+parallelism do not apply; the event axis is inherently sequential per
+workflow (scan), handled by host-side event-chunk streaming (the P6/P7
+pipeline analog, see SURVEY.md §2.6).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
+from ..ops.payload import payload_rows
+from ..ops.replay import replay_events
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices: Optional[list] = None) -> Mesh:
+    """1D mesh over all (or given) devices; axis 'shard' partitions the
+    workflow axis, mirroring numHistoryShards→host assignment (P1)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_events(events: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Place [W, E, L] events with W partitioned over the 'shard' axis."""
+    return jax.device_put(events, NamedSharding(mesh, P(SHARD_AXIS, None, None)))
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def _replay_with_stats(ev: jnp.ndarray, layout: PayloadLayout):
+    s = replay_events(ev, layout)
+    rows = payload_rows(s, layout)
+    # cross-shard aggregation — XLA lowers to all-reduce over the mesh
+    stats = jnp.stack([
+        (s.error != 0).sum().astype(jnp.int64),
+        (s.close_status != 0).sum().astype(jnp.int64),
+    ])
+    return rows, s.error, stats
+
+
+def replay_sharded(events: jnp.ndarray, mesh: Mesh,
+                   layout: PayloadLayout = DEFAULT_LAYOUT
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """SPMD replay over the mesh.
+
+    Returns (payload_rows [W, width] sharded, errors [W] sharded,
+    global_stats [2] replicated = [total_errors, total_closed]); the stats
+    reduction is the cross-shard collective (psum over ICI), standing in for
+    the reference's shard-level ack aggregation.
+    """
+    events = shard_events(events, mesh)
+    # input NamedShardings propagate through jit; no global mesh needed
+    return _replay_with_stats(events, layout)

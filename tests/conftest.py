@@ -7,9 +7,17 @@ time (pytest imports conftest before test modules import jax).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU for tests even when the environment pins a TPU platform
+# (e.g. JAX_PLATFORMS=axon); bench.py runs outside pytest and keeps the TPU
+os.environ["JAX_PLATFORMS"] = "cpu"
 _DEVICE_FLAG = "--xla_force_host_platform_device_count=8"
 if _DEVICE_FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _DEVICE_FLAG).strip()
+
+# this environment pre-imports jax via sitecustomize, which snapshots
+# JAX_PLATFORMS at import time — override through the config API too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
