@@ -29,6 +29,34 @@ def _scan_body(s: ReplayState, ev: jnp.ndarray) -> Tuple[ReplayState, None]:
     return step(s, ev), None
 
 
+@partial(jax.jit, static_argnames=("layout", "max_transfer", "max_timer",
+                                   "retention_days"))
+def replay_events_with_tasks(events: jnp.ndarray,
+                             layout: PayloadLayout = DEFAULT_LAYOUT,
+                             max_transfer: int = 128,
+                             max_timer: int = 128,
+                             retention_days: int = 1):
+    """Replay with task generation: returns (final state, TaskLog).
+
+    The task-emitting variant of replay_events — the full stateBuilder
+    analog (state also feeds the transfer/timer queues, SURVEY.md §3.5).
+    """
+    from .taskgen import init_task_log, step_tasks
+
+    W = events.shape[0]
+    s0 = init_state(W, layout)
+    log0 = init_task_log(W, max_transfer, max_timer)
+
+    def body(carry, ev):
+        s, log = carry
+        s_new = step(s, ev)
+        s_new, log = step_tasks(s_new, ev, log, retention_days)
+        return (s_new, log), None
+
+    (s, log), _ = jax.lax.scan(body, (s0, log0), jnp.swapaxes(events, 0, 1))
+    return s, log
+
+
 @partial(jax.jit, static_argnames=("layout",))
 def replay_events(events: jnp.ndarray,
                   layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:

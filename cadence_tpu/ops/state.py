@@ -146,6 +146,7 @@ class ErrorCode:
     MISSING_SIGNAL = 9
     TABLE_OVERFLOW = 10
     UNKNOWN_EVENT_TYPE = 11
+    INVALID_BACKOFF_INITIATOR = 12
 
 
 def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:

@@ -1,0 +1,325 @@
+"""Device-side transfer/timer task generation during replay.
+
+Replay in the reference does not just rebuild state — it also derives the
+transfer and timer tasks the engine must process
+(mutable_state_task_generator.go, called from the state_builder switch and
+at the end of each ApplyEvents batch). Here tasks are emitted into
+fixed-capacity per-workflow logs ([W, T] lanes + counts) so the host can
+drain them in bulk; numeric fields match the oracle's GeneratedTask stream
+exactly (string fields like task lists are host-resolvable from event IDs).
+
+Replay is the passive-side path: close events emit exactly one
+CloseExecution transfer task + the retention-driven DeleteHistoryEvent
+timer (task_generator.go:180-185,:249-255); active-side cross-cluster
+fan-out belongs to the host engine.
+
+Task logs for workflows whose error flag is set are undefined beyond the
+point of failure (the reference aborts the whole replay transaction there).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from ..core.enums import (
+    EMPTY_EVENT_ID,
+    NANOS_PER_SECOND,
+    EventType,
+    TimeoutType,
+    TimerTaskType,
+    TransferTaskType,
+    WorkflowBackoffTimeoutType,
+)
+from .encode import (
+    LANE_A0,
+    LANE_BATCH_LAST,
+    LANE_EVENT_ID,
+    LANE_EVENT_TYPE,
+    LANE_TIMESTAMP,
+    LANE_VERSION,
+)
+from .state import ReplayState
+
+_I64 = jnp.int64
+_DAY_NANOS = 24 * 3600 * NANOS_PER_SECOND
+
+# decision retry backoff constants (task_generator.go:119-121); jitter draw
+# fixed to 0, matching oracle/task_generator.get_next_decision_timeout_nanos
+_DECISION_RETRY_INIT_NANOS = 60 * NANOS_PER_SECOND
+_DECISION_RETRY_MAX_NANOS = 300 * NANOS_PER_SECOND
+_DECISION_RETRY_KEEP = 0.8  # 1 - defaultJitterCoefficient
+
+
+class TaskLog(NamedTuple):
+    """Per-workflow task emission logs (append-only, capacity-capped)."""
+
+    tr_type: jnp.ndarray      # [W, Tt] i64 TransferTaskType
+    tr_version: jnp.ndarray   # [W, Tt] i64
+    tr_event_id: jnp.ndarray  # [W, Tt] i64 (schedule/initiated id; 0 if n/a)
+    tr_count: jnp.ndarray     # [W] i64
+    tm_type: jnp.ndarray      # [W, Tm] i64 TimerTaskType
+    tm_version: jnp.ndarray   # [W, Tm] i64
+    tm_vis: jnp.ndarray       # [W, Tm] i64 visibility timestamp nanos
+    tm_event_id: jnp.ndarray  # [W, Tm] i64
+    tm_timeout_type: jnp.ndarray  # [W, Tm] i64
+    tm_attempt: jnp.ndarray   # [W, Tm] i64
+    tm_count: jnp.ndarray     # [W] i64
+    overflow: jnp.ndarray     # [W] bool — a log filled up (reported, not silent)
+
+
+def init_task_log(num_workflows: int, max_transfer: int, max_timer: int) -> TaskLog:
+    W = num_workflows
+
+    def z(*shape):
+        return jnp.zeros(shape, _I64)
+
+    return TaskLog(
+        tr_type=z(W, max_transfer), tr_version=z(W, max_transfer),
+        tr_event_id=z(W, max_transfer), tr_count=z(W),
+        tm_type=z(W, max_timer), tm_version=z(W, max_timer),
+        tm_vis=z(W, max_timer), tm_event_id=z(W, max_timer),
+        tm_timeout_type=z(W, max_timer), tm_attempt=z(W, max_timer),
+        tm_count=z(W), overflow=jnp.zeros((W,), jnp.bool_),
+    )
+
+
+def _emit(count, overflow, cap, mask):
+    full = count >= cap
+    do = mask & ~full
+    onehot = (jnp.arange(cap)[None, :] == count[:, None]) & do[:, None]
+    return onehot, count + do.astype(_I64), overflow | (mask & full)
+
+
+from .transitions import _scatter as _w  # same masked one-hot write rule
+
+
+def emit_transfer(log: TaskLog, mask, ttype, version, event_id) -> TaskLog:
+    onehot, count, overflow = _emit(log.tr_count, log.overflow,
+                                    log.tr_type.shape[1], mask)
+    return log._replace(
+        tr_type=_w(log.tr_type, onehot, ttype),
+        tr_version=_w(log.tr_version, onehot, version),
+        tr_event_id=_w(log.tr_event_id, onehot, event_id),
+        tr_count=count, overflow=overflow,
+    )
+
+
+def emit_timer(log: TaskLog, mask, ttype, version, vis, event_id,
+               timeout_type, attempt) -> TaskLog:
+    onehot, count, overflow = _emit(log.tm_count, log.overflow,
+                                    log.tm_type.shape[1], mask)
+    return log._replace(
+        tm_type=_w(log.tm_type, onehot, ttype),
+        tm_version=_w(log.tm_version, onehot, version),
+        tm_vis=_w(log.tm_vis, onehot, vis),
+        tm_event_id=_w(log.tm_event_id, onehot, event_id),
+        tm_timeout_type=_w(log.tm_timeout_type, onehot, timeout_type),
+        tm_attempt=_w(log.tm_attempt, onehot, attempt),
+        tm_count=count, overflow=overflow,
+    )
+
+
+def _lex_min3(valid, ts, eid, ttype):
+    """Lexicographic argmin over (ts, event_id, timer_type) among valid slots.
+
+    Mirrors TimerSequenceIDs.Less (timer_sequence.go:459-493). Returns
+    (found [W], sel [W,K] one-hot of the winning slot)."""
+    big = jnp.int64(1 << 62)
+    found = valid.any(axis=1)
+    t1 = jnp.where(valid, ts, big)
+    min_ts = t1.min(axis=1)
+    m1 = valid & (t1 == min_ts[:, None])
+    e1 = jnp.where(m1, eid, big)
+    min_e = e1.min(axis=1)
+    m2 = m1 & (e1 == min_e[:, None])
+    y1 = jnp.where(m2, ttype, big)
+    min_y = y1.min(axis=1)
+    m3 = m2 & (y1 == min_y[:, None])
+    # ties fully broken by (ts, eid, type); keep first slot for safety
+    K = valid.shape[1]
+    first = jnp.where(m3, jnp.arange(K)[None, :], K).min(axis=1)
+    sel = (jnp.arange(K)[None, :] == first[:, None]) & found[:, None]
+    return found, sel
+
+
+def batch_end_timer_tasks(s: ReplayState, log: TaskLog,
+                          mask) -> Tuple[ReplayState, TaskLog]:
+    """GenerateActivityTimerTasks + GenerateUserTimerTasks at batch end
+    (state_builder.go:634-640; timer_sequence.go CreateNext*Timer)."""
+    act = s.activities
+    W, K = act.occ.shape
+    empty = act.started_id == EMPTY_EVENT_ID
+
+    # four candidate timers per activity (timer_sequence.go:219-254)
+    cand_valid = jnp.concatenate([
+        act.occ,                                  # schedule-to-close
+        act.occ & empty,                          # schedule-to-start
+        act.occ & ~empty,                         # start-to-close
+        act.occ & ~empty & (act.heartbeat > 0),   # heartbeat
+    ], axis=1)
+    cand_ts = jnp.concatenate([
+        act.scheduled_time + act.sched_to_close * NANOS_PER_SECOND,
+        act.scheduled_time + act.sched_to_start * NANOS_PER_SECOND,
+        act.started_time + act.start_to_close * NANOS_PER_SECOND,
+        jnp.maximum(act.started_time, act.last_heartbeat)
+        + act.heartbeat * NANOS_PER_SECOND,
+    ], axis=1)
+    cand_eid = jnp.tile(act.schedule_id, (1, 4))
+    type_codes = [TimeoutType.ScheduleToClose, TimeoutType.ScheduleToStart,
+                  TimeoutType.StartToClose, TimeoutType.Heartbeat]
+    cand_type = jnp.concatenate([
+        jnp.full((W, K), int(t), _I64) for t in type_codes
+    ], axis=1)
+    status_bits = [4, 2, 1, 8]  # TIMER_TASK_STATUS_CREATED_* per quadrant
+    cand_bit = jnp.concatenate([
+        jnp.full((W, K), b, jnp.int32) for b in status_bits
+    ], axis=1)
+    cand_created = (jnp.tile(act.timer_status, (1, 4)) & cand_bit) > 0
+
+    found, sel = _lex_min3(cand_valid & mask[:, None], cand_ts, cand_eid, cand_type)
+    # only create when the first (minimum) timer is not yet created
+    # (CreateNextActivityTimer returns early otherwise, :171-174)
+    fresh = found & ~(jnp.where(sel, cand_created, False).any(axis=1))
+    sel = sel & fresh[:, None]
+    sel_ts = jnp.where(sel, cand_ts, 0).sum(axis=1)
+    sel_eid = jnp.where(sel, cand_eid, 0).sum(axis=1)
+    sel_type = jnp.where(sel, cand_type, 0).sum(axis=1)
+    sel_attempt_src = jnp.tile(act.attempt, (1, 4))
+    sel_attempt = jnp.where(sel, sel_attempt_src, 0).sum(axis=1)
+    # fold the 4 quadrants back onto table slots to set the created bit
+    slot_sel = sel[:, 0:K] | sel[:, K:2 * K] | sel[:, 2 * K:3 * K] | sel[:, 3 * K:]
+    bit = jnp.where(sel, cand_bit, 0).sum(axis=1).astype(jnp.int32)
+    act = act._replace(
+        timer_status=jnp.where(slot_sel, act.timer_status | bit[:, None],
+                               act.timer_status)
+    )
+    log = emit_timer(
+        log, fresh, jnp.int64(TimerTaskType.ActivityTimeout),
+        s.current_version, sel_ts, sel_eid, sel_type, sel_attempt,
+    )
+
+    # user timers (timer_sequence.go:127-160): single candidate per timer
+    tmr = s.timers
+    created = tmr.task_status == 1
+    found, sel = _lex_min3(tmr.occ & mask[:, None], tmr.expiry_time,
+                           tmr.started_id,
+                           jnp.zeros_like(tmr.started_id))
+    fresh = found & ~(jnp.where(sel, created, False).any(axis=1))
+    sel = sel & fresh[:, None]
+    sel_ts = jnp.where(sel, tmr.expiry_time, 0).sum(axis=1)
+    sel_eid = jnp.where(sel, tmr.started_id, 0).sum(axis=1)
+    tmr = tmr._replace(
+        task_status=jnp.where(sel, jnp.int32(1), tmr.task_status)
+    )
+    log = emit_timer(
+        log, fresh, jnp.int64(TimerTaskType.UserTimer),
+        s.current_version, sel_ts, sel_eid,
+        jnp.zeros_like(sel_eid), jnp.zeros_like(sel_eid),
+    )
+    return s._replace(activities=act, timers=tmr), log
+
+
+def step_tasks(s_new: ReplayState, ev: jnp.ndarray,
+               log: TaskLog, retention_days: int
+               ) -> Tuple[ReplayState, TaskLog]:
+    """Emit the tasks generated by applying `ev` (post-step state s_new)."""
+    ev_id = ev[:, LANE_EVENT_ID]
+    etype = ev[:, LANE_EVENT_TYPE]
+    ev_version = ev[:, LANE_VERSION]
+    ts = ev[:, LANE_TIMESTAMP]
+    batch_last = ev[:, LANE_BATCH_LAST]
+    a = [ev[:, LANE_A0 + i] for i in range(8)]
+
+    ok = (ev_id > 0) & (s_new.error == 0)
+
+    def m(t: EventType):
+        return ok & (etype == int(t))
+
+    # --- WorkflowExecutionStarted (state_builder.go:158-177)
+    m_started = m(EventType.WorkflowExecutionStarted)
+    log = emit_transfer(log, m_started,
+                        jnp.int64(TransferTaskType.RecordWorkflowStarted),
+                        ev_version, jnp.zeros_like(ev_id))
+    backoff = a[2] * NANOS_PER_SECOND
+    wf_timeout_ts = ts + s_new.workflow_timeout * NANOS_PER_SECOND + backoff
+    cap = (a[3] > 0) & (s_new.expiration_time != 0) & (wf_timeout_ts > s_new.expiration_time)
+    wf_timeout_ts = jnp.where(cap, s_new.expiration_time, wf_timeout_ts)
+    log = emit_timer(log, m_started, jnp.int64(TimerTaskType.WorkflowTimeout),
+                     ev_version, wf_timeout_ts, jnp.zeros_like(ev_id),
+                     jnp.zeros_like(ev_id), jnp.zeros_like(ev_id))
+    m_backoff = m_started & (a[2] > 0)
+    # initiator lane: -1 none → Cron; RetryPolicy → Retry (task_generator.go:271-288)
+    backoff_type = jnp.where(
+        a[7] == 1,
+        jnp.int64(WorkflowBackoffTimeoutType.Retry),
+        jnp.int64(WorkflowBackoffTimeoutType.Cron),
+    )
+    log = emit_timer(log, m_backoff, jnp.int64(TimerTaskType.WorkflowBackoffTimer),
+                     ev_version, ts + backoff, jnp.zeros_like(ev_id),
+                     backoff_type, jnp.zeros_like(ev_id))
+
+    # --- DecisionTask transfer on schedule + on transient schedule
+    # (state_builder.go:204-208,:250-259,:272-281; task_generator.go:315-350;
+    # no schedule-to-start timer on the replay path)
+    m_dsched = m(EventType.DecisionTaskScheduled)
+    m_dfail = m(EventType.DecisionTaskFailed) | m(EventType.DecisionTaskTimedOut)
+    log = emit_transfer(log, m_dsched | m_dfail,
+                        jnp.int64(TransferTaskType.DecisionTask),
+                        s_new.decision_version, s_new.decision_schedule_id)
+
+    # --- DecisionTaskStarted → start-to-close timeout timer
+    # (task_generator.go:352-388); attempt escalation does not fire on the
+    # replay path because replicated starts reset attempt to 0
+    m_dstart = m(EventType.DecisionTaskStarted)
+    dstart_timeout = s_new.decision_timeout * NANOS_PER_SECOND
+    log = emit_timer(log, m_dstart, jnp.int64(TimerTaskType.DecisionTimeout),
+                     s_new.decision_version,
+                     s_new.decision_started_ts + dstart_timeout,
+                     s_new.decision_schedule_id,
+                     jnp.full_like(ev_id, int(TimeoutType.StartToClose)),
+                     s_new.decision_attempt)
+
+    # --- ActivityTaskScheduled → ActivityTask transfer (task_generator.go:390-428)
+    log = emit_transfer(log, m(EventType.ActivityTaskScheduled),
+                        jnp.int64(TransferTaskType.ActivityTask),
+                        ev_version, ev_id)
+
+    # --- StartChildWorkflowExecutionInitiated (task_generator.go:451-498)
+    log = emit_transfer(log, m(EventType.StartChildWorkflowExecutionInitiated),
+                        jnp.int64(TransferTaskType.StartChildExecution),
+                        ev_version, ev_id)
+
+    # --- external cancel / signal initiated (task_generator.go:500-600)
+    log = emit_transfer(log, m(EventType.RequestCancelExternalWorkflowExecutionInitiated),
+                        jnp.int64(TransferTaskType.CancelExecution),
+                        ev_version, ev_id)
+    log = emit_transfer(log, m(EventType.SignalExternalWorkflowExecutionInitiated),
+                        jnp.int64(TransferTaskType.SignalExecution),
+                        ev_version, ev_id)
+
+    # --- UpsertWorkflowSearchAttributes (task_generator.go:602-612)
+    log = emit_transfer(log, m(EventType.UpsertWorkflowSearchAttributes),
+                        jnp.int64(TransferTaskType.UpsertWorkflowSearchAttributes),
+                        s_new.current_version, jnp.zeros_like(ev_id))
+
+    # --- close events: CloseExecution transfer + retention deletion timer
+    # (task_generator.go:168-258, passive path)
+    m_close = jnp.zeros_like(ok)
+    for et in (EventType.WorkflowExecutionCompleted,
+               EventType.WorkflowExecutionFailed,
+               EventType.WorkflowExecutionTimedOut,
+               EventType.WorkflowExecutionCanceled,
+               EventType.WorkflowExecutionTerminated,
+               EventType.WorkflowExecutionContinuedAsNew):
+        m_close = m_close | m(et)
+    log = emit_transfer(log, m_close, jnp.int64(TransferTaskType.CloseExecution),
+                        ev_version, jnp.zeros_like(ev_id))
+    log = emit_timer(log, m_close, jnp.int64(TimerTaskType.DeleteHistoryEvent),
+                     ev_version, ts + retention_days * _DAY_NANOS,
+                     jnp.zeros_like(ev_id), jnp.zeros_like(ev_id),
+                     jnp.zeros_like(ev_id))
+
+    # --- batch end: activity + user timer tasks
+    m_end = ok & (batch_last == 1)
+    return batch_end_timer_tasks(s_new, log, m_end)

@@ -218,6 +218,12 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     error = _set_err(error, started_bad, ErrorCode.INVALID_STATE_TRANSITION)
     m_started = m_started & ~started_bad
 
+    # a first-decision backoff with a Decider or unknown initiator is
+    # rejected (task_generator.go:279-287); lane a7: -1 none, 1 retry, 2 cron
+    bad_initiator = m_started & (a[2] > 0) & ((a[7] == 0) | (a[7] >= 3))
+    error = _set_err(error, bad_initiator, ErrorCode.INVALID_BACKOFF_INITIATOR)
+    m_started = m_started & ~bad_initiator
+
     workflow_timeout = _sel(m_started, a[0], s.workflow_timeout)
     decision_sts_timeout = _sel(m_started, a[1], s.decision_sts_timeout)
     start_timestamp = _sel(m_started, ts, s.start_timestamp)

@@ -1,0 +1,70 @@
+"""Device task generation must match the oracle's transfer/timer task
+streams (numeric fields) on every suite — the full stateBuilder parity
+contract: replay rebuilds state AND derives the same tasks."""
+import numpy as np
+import pytest
+
+from cadence_tpu.gen.corpus import SUITES, generate_corpus
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.ops.encode import encode_corpus
+from cadence_tpu.ops.replay import replay_events_with_tasks
+
+import jax.numpy as jnp
+
+
+def oracle_task_streams(history):
+    ms = StateBuilder().replay_history(history)
+    transfers = [(int(t.task_type), t.version, t.event_id)
+                 for t in ms.transfer_tasks]
+    timers = [(int(t.task_type), t.version, t.visibility_timestamp,
+               t.event_id, int(t.timeout_type), t.attempt)
+              for t in ms.timer_tasks]
+    return transfers, timers
+
+
+def device_task_streams(log, w):
+    nt = int(log.tr_count[w])
+    transfers = [
+        (int(log.tr_type[w, i]), int(log.tr_version[w, i]),
+         int(log.tr_event_id[w, i]))
+        for i in range(nt)
+    ]
+    nm = int(log.tm_count[w])
+    timers = [
+        (int(log.tm_type[w, i]), int(log.tm_version[w, i]),
+         int(log.tm_vis[w, i]), int(log.tm_event_id[w, i]),
+         int(log.tm_timeout_type[w, i]), int(log.tm_attempt[w, i]))
+        for i in range(nm)
+    ]
+    return transfers, timers
+
+
+@pytest.mark.parametrize("suite", SUITES)
+def test_task_stream_parity(suite):
+    histories = generate_corpus(suite, num_workflows=8, seed=21,
+                                target_events=80)
+    events = jnp.asarray(encode_corpus(histories))
+    state, log = replay_events_with_tasks(events, max_transfer=96, max_timer=96)
+    log = type(log)(*[np.asarray(x) for x in log])
+    errors = np.asarray(state.error)
+    assert (errors == 0).all()
+    assert not log.overflow.any()
+    for w, h in enumerate(histories):
+        otr, otm = oracle_task_streams(h)
+        dtr, dtm = device_task_streams(log, w)
+        assert dtr == otr, (
+            f"suite={suite} wf={w}: transfer stream diverges\n"
+            f" oracle[:6]={otr[:6]}\n device[:6]={dtr[:6]}"
+        )
+        assert dtm == otm, (
+            f"suite={suite} wf={w}: timer stream diverges\n"
+            f" oracle[:6]={otm[:6]}\n device[:6]={dtm[:6]}"
+        )
+
+
+def test_task_log_overflow_reported():
+    histories = generate_corpus("basic", num_workflows=2, seed=3,
+                                target_events=100)
+    events = jnp.asarray(encode_corpus(histories))
+    _, log = replay_events_with_tasks(events, max_transfer=4, max_timer=4)
+    assert bool(np.asarray(log.overflow).all())
