@@ -217,6 +217,19 @@ TIMER_TYPE_TO_STATUS_MASK = {
     TimeoutType.Heartbeat: TIMER_TASK_STATUS_CREATED_HEARTBEAT,
 }
 
+# Close events and the close status each one sets
+# (mutable_state_builder.go:2561-2655,:2719-2733,:3225-3240,:3366-3382) —
+# shared by the device transition kernel and task generator so the two can
+# never enumerate different close sets.
+CLOSE_EVENT_STATUS = (
+    (EventType.WorkflowExecutionCompleted, CloseStatus.Completed),
+    (EventType.WorkflowExecutionFailed, CloseStatus.Failed),
+    (EventType.WorkflowExecutionTimedOut, CloseStatus.TimedOut),
+    (EventType.WorkflowExecutionCanceled, CloseStatus.Canceled),
+    (EventType.WorkflowExecutionTerminated, CloseStatus.Terminated),
+    (EventType.WorkflowExecutionContinuedAsNew, CloseStatus.ContinuedAsNew),
+)
+
 # --- Sentinels ----------------------------------------------------------------
 # Reference: /root/reference/common/constants.go:30-58
 

@@ -1,0 +1,91 @@
+"""Shard controller: shard ownership driven by the membership hashring.
+
+Reference: service/history/shard/controller.go — each history host runs a
+controller that acquires the shards the hashring assigns to it
+(acquireShards:381) and releases the rest (shardClosedCallback:258);
+engines are created per shard through the EngineFactory seam (:55-58,
+default factory at handler.go:266). That seam is exactly where this
+framework's TPU engine plugs in (tpu_engine.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ..utils.clock import TimeSource
+from .history_engine import HistoryEngine
+from .membership import HashRing, shard_id_for_workflow
+from .persistence import Stores
+from .shard import ShardContext
+
+EngineFactory = Callable[[ShardContext], HistoryEngine]
+
+
+class ShardController:
+    def __init__(self, host: str, num_shards: int, stores: Stores,
+                 ring: HashRing, time_source: TimeSource,
+                 engine_factory: Optional[EngineFactory] = None) -> None:
+        self.host = host
+        self.num_shards = num_shards
+        self.stores = stores
+        self.ring = ring
+        self.clock = time_source
+        self._factory = engine_factory or self._default_factory
+        self._lock = threading.Lock()
+        self._engines: Dict[int, HistoryEngine] = {}
+        ring.subscribe(self._on_membership_change)
+
+    def _default_factory(self, shard: ShardContext) -> HistoryEngine:
+        return HistoryEngine(shard, self.stores, self.clock)
+
+    def _owns(self, shard_id: int) -> bool:
+        return self.ring.lookup(f"shard-{shard_id}") == self.host
+
+    def shard_for(self, workflow_id: str) -> int:
+        return shard_id_for_workflow(workflow_id, self.num_shards)
+
+    def engine_for_shard(self, shard_id: int) -> HistoryEngine:
+        """GetEngineForShard (controller.go:199-211): create+acquire lazily."""
+        if not self._owns(shard_id):
+            raise ShardNotOwnedError(
+                f"host {self.host} does not own shard {shard_id} "
+                f"(owner: {self.ring.lookup(f'shard-{shard_id}')})"
+            )
+        with self._lock:
+            engine = self._engines.get(shard_id)
+            if engine is None:
+                ctx = ShardContext(shard_id, self.host, self.stores)
+                ctx.acquire()
+                engine = self._factory(ctx)
+                self._engines[shard_id] = engine
+            return engine
+
+    def engine_for_workflow(self, workflow_id: str) -> HistoryEngine:
+        return self.engine_for_shard(self.shard_for(workflow_id))
+
+    def owned_shards(self):
+        with self._lock:
+            return sorted(self._engines.keys())
+
+    def assigned_shards(self):
+        """All shards the ring currently assigns to this host (whether or not
+        an engine exists yet) — what the queue processors must sweep."""
+        return [s for s in range(self.num_shards) if self._owns(s)]
+
+    def _on_membership_change(self) -> None:
+        """acquireShards (controller.go:381): release shards the ring no
+        longer assigns here and eagerly acquire newly assigned ones, so
+        their queues resume from persisted ack levels without waiting for a
+        routed request."""
+        with self._lock:
+            for shard_id in list(self._engines.keys()):
+                if not self._owns(shard_id):
+                    self._engines[shard_id].shard.close()
+                    del self._engines[shard_id]
+        for shard_id in self.assigned_shards():
+            self.engine_for_shard(shard_id)
+
+
+class ShardNotOwnedError(Exception):
+    """Routing error: caller must redirect to the owning host (the
+    client/history peer-resolver redirect analog)."""

@@ -1,0 +1,193 @@
+"""Frontend: the public API gateway.
+
+Reference: service/frontend/workflowHandler.go (domain CRUD :265-437,
+polls :471/:580, StartWorkflowExecution :1940, Signal :2378,
+Terminate/Cancel :2674-2783, List :2837, GetWorkflowExecutionHistory :2106,
+DescribeTaskList :3593). Requests route to the owning history host via the
+membership ring (client/history peer resolver analog) — in this in-process
+cluster, via the cluster-wide router over all controllers.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.events import HistoryEvent, RetryPolicy
+from ..oracle.mutable_state import MutableState
+from .history_engine import Decision, HistoryEngine, TaskToken
+from .matching import (
+    TASK_LIST_TYPE_ACTIVITY,
+    TASK_LIST_TYPE_DECISION,
+    MatchedTask,
+    MatchingEngine,
+)
+from .persistence import DomainInfo, Stores, VisibilityRecord
+
+
+class PollDecisionResponse:
+    def __init__(self, token: TaskToken, history: List[HistoryEvent],
+                 previous_started_event_id: int) -> None:
+        self.token = token
+        self.history = history
+        self.previous_started_event_id = previous_started_event_id
+
+
+class PollActivityResponse:
+    def __init__(self, token: TaskToken, activity_id: str,
+                 activity_type: str = "") -> None:
+        self.token = token
+        self.activity_id = activity_id
+        self.activity_type = activity_type
+
+
+class Frontend:
+    def __init__(self, stores: Stores, matching: MatchingEngine,
+                 router: Callable[[str], HistoryEngine]) -> None:
+        self.stores = stores
+        self.matching = matching
+        self.router = router
+
+    # -- domains (workflowHandler.go:265-437) ------------------------------
+
+    def register_domain(self, name: str, retention_days: int = 1,
+                        is_active: bool = True) -> str:
+        domain_id = str(uuid.uuid4())
+        self.stores.domain.register(DomainInfo(
+            domain_id=domain_id, name=name, retention_days=retention_days,
+            is_active=is_active))
+        return domain_id
+
+    def describe_domain(self, name: str) -> DomainInfo:
+        return self.stores.domain.by_name(name)
+
+    def list_domains(self) -> List[DomainInfo]:
+        return self.stores.domain.list_domains()
+
+    # -- workflow lifecycle ------------------------------------------------
+
+    def start_workflow_execution(self, domain: str, workflow_id: str,
+                                 workflow_type: str, task_list: str,
+                                 execution_timeout: int = 3600,
+                                 decision_timeout: int = 10,
+                                 cron_schedule: str = "",
+                                 first_decision_backoff: int = 0,
+                                 retry_policy: Optional[RetryPolicy] = None,
+                                 ) -> str:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        engine = self.router(workflow_id)
+        return engine.start_workflow(
+            domain_id=domain_id, workflow_id=workflow_id,
+            workflow_type=workflow_type, task_list=task_list,
+            execution_timeout=execution_timeout,
+            decision_timeout=decision_timeout,
+            cron_schedule=cron_schedule,
+            first_decision_backoff=first_decision_backoff,
+            retry_policy=retry_policy,
+        )
+
+    def signal_workflow_execution(self, domain: str, workflow_id: str,
+                                  signal_name: str,
+                                  run_id: Optional[str] = None) -> None:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        self.router(workflow_id).signal_workflow(domain_id, workflow_id,
+                                                 signal_name, run_id)
+
+    def request_cancel_workflow_execution(self, domain: str, workflow_id: str,
+                                          run_id: Optional[str] = None) -> None:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        self.router(workflow_id).request_cancel_workflow(domain_id, workflow_id,
+                                                         run_id)
+
+    def terminate_workflow_execution(self, domain: str, workflow_id: str,
+                                     run_id: Optional[str] = None,
+                                     reason: str = "") -> None:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        self.router(workflow_id).terminate_workflow(domain_id, workflow_id,
+                                                    run_id, reason)
+
+    # -- worker polls ------------------------------------------------------
+
+    def poll_for_decision_task(self, domain: str, task_list: str
+                               ) -> Optional[PollDecisionResponse]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        task = self.matching.poll_for_decision_task(domain_id, task_list)
+        if task is None:
+            return None
+        engine = self.router(task.workflow_id)
+        from .history_engine import InvalidRequestError
+        try:
+            token = engine.record_decision_task_started(
+                task.domain_id, task.workflow_id, task.run_id,
+                task.schedule_id, request_id=str(uuid.uuid4()))
+        except InvalidRequestError:
+            return None  # stale task (decision already handled) — skip
+        ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
+                                      task.run_id)
+        history = engine.get_history(task.domain_id, task.workflow_id,
+                                     task.run_id)
+        return PollDecisionResponse(
+            token=token, history=history,
+            previous_started_event_id=ms.execution_info.last_processed_event)
+
+    def respond_decision_task_completed(self, token: TaskToken,
+                                        decisions: List[Decision]) -> None:
+        self.router(token.workflow_id).respond_decision_task_completed(
+            token, decisions)
+
+    def poll_for_activity_task(self, domain: str, task_list: str
+                               ) -> Optional[PollActivityResponse]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        task = self.matching.poll_for_activity_task(domain_id, task_list)
+        if task is None:
+            return None
+        engine = self.router(task.workflow_id)
+        from .history_engine import InvalidRequestError
+        try:
+            token = engine.record_activity_task_started(
+                task.domain_id, task.workflow_id, task.run_id,
+                task.schedule_id, request_id=str(uuid.uuid4()))
+        except InvalidRequestError:
+            return None  # stale (activity timed out / workflow closed)
+        ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
+                                      task.run_id)
+        ai = ms.pending_activity_info_ids.get(task.schedule_id)
+        return PollActivityResponse(token=token,
+                                    activity_id=ai.activity_id if ai else "")
+
+    def respond_activity_task_completed(self, token: TaskToken,
+                                        result: bytes = b"") -> None:
+        self.router(token.workflow_id).respond_activity_task_completed(
+            token, result)
+
+    def respond_activity_task_failed(self, token: TaskToken,
+                                     reason: str = "") -> None:
+        self.router(token.workflow_id).respond_activity_task_failed(token, reason)
+
+    # -- reads -------------------------------------------------------------
+
+    def get_workflow_execution_history(self, domain: str, workflow_id: str,
+                                       run_id: Optional[str] = None
+                                       ) -> List[HistoryEvent]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.router(workflow_id).get_history(domain_id, workflow_id, run_id)
+
+    def describe_workflow_execution(self, domain: str, workflow_id: str,
+                                    run_id: Optional[str] = None
+                                    ) -> MutableState:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.router(workflow_id).get_mutable_state(domain_id,
+                                                          workflow_id, run_id)
+
+    def list_open_workflow_executions(self, domain: str) -> List[VisibilityRecord]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.stores.visibility.list_open(domain_id)
+
+    def list_closed_workflow_executions(self, domain: str) -> List[VisibilityRecord]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.stores.visibility.list_closed(domain_id)
+
+    def describe_task_list(self, domain: str, task_list: str,
+                           task_type: int = TASK_LIST_TYPE_DECISION
+                           ) -> Dict[str, int]:
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.matching.describe_task_list(domain_id, task_list, task_type)

@@ -1,0 +1,626 @@
+"""History engine: the active-side per-shard workflow state engine.
+
+Reference: service/history/historyEngine.go (engine.Engine interface at
+service/history/engine/interface.go:36) + decision/task_handler.go (decision
+translation) + decision/handler.go (decision lifecycle).
+
+Design note (TPU-first restructuring): the reference maintains two parallel
+mutation paths — active `Add*Event` methods and passive `Replicate*Event`
+methods — with the active path calling the passive one internally
+(e.g. AddActivityTaskScheduledEvent → ReplicateActivityTaskScheduledEvent,
+mutable_state_builder.go:2096-2139). This engine goes all the way: every
+active transaction CONSTRUCTS its event batch, then applies it through the
+same StateBuilder used for replay. Active state is therefore identical to
+replayed state by construction, and the TPU kernel can verify any live
+workflow by replaying its persisted history (see tpu_engine.py).
+
+Each public method is one workflow transaction:
+  load state → build event batch → apply (oracle semantics) → persist
+  {history append, fenced conditional state update, shard task inserts}
+mirroring context.UpdateWorkflowExecutionAsActive (execution/context.go:105).
+"""
+from __future__ import annotations
+
+import copy
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.checksum import Checksum
+from ..core.enums import (
+    EMPTY_EVENT_ID,
+    CloseStatus,
+    DecisionType,
+    EventType,
+    TimeoutType,
+    WorkflowState,
+)
+from ..core.events import HistoryBatch, HistoryEvent, RetryPolicy
+from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
+from ..oracle.state_builder import StateBuilder
+from ..utils.clock import TimeSource
+from .persistence import DomainInfo, EntityNotExistsError, Stores
+from .shard import ShardContext
+
+
+class InvalidRequestError(Exception):
+    """BadRequestError analog (invalid decision/request for current state)."""
+
+
+@dataclass
+class TaskToken:
+    """Opaque token tying a dispatched task to its workflow transaction
+    (reference: common taskToken serialized into matching responses)."""
+
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    schedule_id: int
+    started_id: int = EMPTY_EVENT_ID
+
+
+@dataclass
+class Decision:
+    """One worker decision (types.Decision analog)."""
+
+    decision_type: DecisionType
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class HistoryEngine:
+    """Per-shard engine (historyEngineImpl analog)."""
+
+    def __init__(self, shard: ShardContext, stores: Stores,
+                 time_source: TimeSource) -> None:
+        self.shard = shard
+        self.stores = stores
+        self.clock = time_source
+
+    # ------------------------------------------------------------------
+    # transaction plumbing
+    # ------------------------------------------------------------------
+
+    def _domain_entry(self, domain_id: str) -> DomainEntry:
+        try:
+            d = self.stores.domain.by_id(domain_id)
+            return DomainEntry(domain_id=d.domain_id, name=d.name,
+                               is_active=d.is_active,
+                               retention_days=d.retention_days,
+                               failover_version=d.failover_version)
+        except EntityNotExistsError:
+            return DomainEntry(domain_id=domain_id, is_active=True)
+
+    def _load(self, domain_id: str, workflow_id: str,
+              run_id: Optional[str] = None) -> Tuple[MutableState, int]:
+        if run_id is None:
+            run_id = self.stores.execution.get_current_run_id(domain_id, workflow_id)
+        ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
+        # work on a copy so a failed transaction never corrupts the store
+        ms = copy.deepcopy(ms)
+        return ms, ms.execution_info.next_event_id
+
+    def _new_transaction(self, ms: MutableState) -> "_Txn":
+        return _Txn(self, ms)
+
+    # ------------------------------------------------------------------
+    # StartWorkflowExecution (historyEngine.go:547, startWorkflowHelper:583)
+    # ------------------------------------------------------------------
+
+    def start_workflow(self, domain_id: str, workflow_id: str,
+                       workflow_type: str, task_list: str,
+                       execution_timeout: int = 3600,
+                       decision_timeout: int = 10,
+                       input_payload: bytes = b"",
+                       cron_schedule: str = "",
+                       first_decision_backoff: int = 0,
+                       retry_policy: Optional[RetryPolicy] = None,
+                       parent: Optional[Dict[str, Any]] = None,
+                       request_id: Optional[str] = None) -> str:
+        run_id = str(uuid.uuid4())
+        ms = MutableState(self._domain_entry(domain_id))
+        now = self.clock.now()
+        start_attrs: Dict[str, Any] = dict(
+            task_list=task_list, workflow_type=workflow_type,
+            execution_start_to_close_timeout_seconds=execution_timeout,
+            task_start_to_close_timeout_seconds=decision_timeout,
+            first_execution_run_id=run_id,
+        )
+        if cron_schedule:
+            start_attrs["cron_schedule"] = cron_schedule
+        if first_decision_backoff > 0:
+            start_attrs["first_decision_task_backoff_seconds"] = first_decision_backoff
+        if retry_policy is not None:
+            start_attrs["retry_policy"] = retry_policy
+        if parent:
+            start_attrs.update(parent)
+
+        events = [
+            HistoryEvent(id=1, event_type=EventType.WorkflowExecutionStarted,
+                         timestamp=now, attrs=start_attrs),
+        ]
+        # generateFirstDecisionTask (historyEngine.go:529) unless delayed
+        if first_decision_backoff <= 0:
+            events.append(HistoryEvent(
+                id=2, event_type=EventType.DecisionTaskScheduled, timestamp=now,
+                attrs=dict(task_list=task_list,
+                           start_to_close_timeout_seconds=decision_timeout,
+                           attempt=0),
+            ))
+        batch = HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
+                             run_id=run_id, events=events,
+                             request_id=request_id or str(uuid.uuid4()))
+        sb = StateBuilder(ms)
+        sb.apply_batch(batch)
+
+        self.shard.create_workflow(ms)
+        self.stores.history.append_batch(domain_id, workflow_id, run_id, events)
+        self.shard.insert_tasks(domain_id, workflow_id, run_id,
+                                ms.transfer_tasks, ms.timer_tasks)
+        ms.transfer_tasks, ms.timer_tasks = [], []
+        return run_id
+
+    # ------------------------------------------------------------------
+    # Decision task lifecycle (decision/handler.go)
+    # ------------------------------------------------------------------
+
+    def record_decision_task_started(self, domain_id: str, workflow_id: str,
+                                     run_id: str, schedule_id: int,
+                                     request_id: str) -> TaskToken:
+        """HandleDecisionTaskStarted (decision/handler.go).
+
+        Transient decisions (attempt > 0 after a failed/timed-out decision)
+        exist only in mutable state until picked up; on start the real
+        scheduled+started pair is written as one batch — the two-batch
+        "transaction" described at mutable_state_decision_task_manager.go:215-223
+        — and ReplicateDecisionTaskScheduledEvent overwrites the transient's
+        provisional schedule ID (:180-182)."""
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            # checkMutability analog (mutable_state_builder.go checkMutability)
+            raise InvalidRequestError("workflow execution already completed")
+        if info.decision_schedule_id != schedule_id:
+            raise InvalidRequestError(
+                f"decision {schedule_id} not pending (have {info.decision_schedule_id})"
+            )
+        if info.decision_started_id != EMPTY_EVENT_ID:
+            raise InvalidRequestError("decision already started")
+        txn = self._new_transaction(ms)
+        if info.decision_attempt > 0:
+            sched = txn.add(EventType.DecisionTaskScheduled,
+                            task_list=info.task_list,
+                            start_to_close_timeout_seconds=info.decision_timeout,
+                            attempt=info.decision_attempt)
+            schedule_id = sched.id
+        started = txn.add(EventType.DecisionTaskStarted,
+                          scheduled_event_id=schedule_id, request_id=request_id)
+        txn.commit(expected)
+        return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
+                         run_id=run_id, schedule_id=schedule_id,
+                         started_id=started.id)
+
+    def respond_decision_task_completed(self, token: TaskToken,
+                                        decisions: List[Decision]) -> None:
+        """RespondDecisionTaskCompleted (historyEngine.go:1787 →
+        decision/handler.go:285, per-decision translation per
+        decision/task_handler.go)."""
+        ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            raise InvalidRequestError("workflow execution already completed")
+        if (info.decision_schedule_id != token.schedule_id
+                or info.decision_started_id != token.started_id):
+            raise InvalidRequestError("decision task no longer current")
+        txn = self._new_transaction(ms)
+        completed = txn.add(EventType.DecisionTaskCompleted,
+                            scheduled_event_id=token.schedule_id,
+                            started_event_id=token.started_id)
+        closed = False
+        for d in decisions:
+            closed = self._apply_decision(txn, ms, completed.id, d) or closed
+            if closed:
+                break
+        txn.commit(expected)
+        # continue-as-new chaining is handled inside _apply_decision
+
+    def _apply_decision(self, txn: "_Txn", ms: MutableState,
+                        completed_id: int, d: Decision) -> bool:
+        """One decision → events (decision/task_handler.go switch). Returns
+        True when the decision closes the workflow."""
+        a = d.attrs
+        dt = d.decision_type
+        if dt == DecisionType.ScheduleActivityTask:
+            if a.get("activity_id") in ms.pending_activity_id_to_event_id:
+                raise InvalidRequestError(f"duplicate activity {a.get('activity_id')}")
+            txn.add(EventType.ActivityTaskScheduled,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.StartTimer:
+            if a.get("timer_id") in ms.pending_timer_info_ids:
+                raise InvalidRequestError(f"duplicate timer {a.get('timer_id')}")
+            txn.add(EventType.TimerStarted,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.CancelTimer:
+            if a.get("timer_id") not in ms.pending_timer_info_ids:
+                raise InvalidRequestError(f"unknown timer {a.get('timer_id')}")
+            ti = ms.pending_timer_info_ids[a["timer_id"]]
+            txn.add(EventType.TimerCanceled, timer_id=a["timer_id"],
+                    started_event_id=ti.started_id,
+                    decision_task_completed_event_id=completed_id)
+        elif dt == DecisionType.RequestCancelActivityTask:
+            sched = ms.pending_activity_id_to_event_id.get(a.get("activity_id"))
+            if sched is None:
+                txn.add(EventType.RequestCancelActivityTaskFailed,
+                        activity_id=a.get("activity_id"),
+                        cause="ACTIVITY_ID_UNKNOWN",
+                        decision_task_completed_event_id=completed_id)
+            else:
+                txn.add(EventType.ActivityTaskCancelRequested,
+                        activity_id=a.get("activity_id"),
+                        decision_task_completed_event_id=completed_id)
+        elif dt == DecisionType.RecordMarker:
+            txn.add(EventType.MarkerRecorded,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.UpsertWorkflowSearchAttributes:
+            txn.add(EventType.UpsertWorkflowSearchAttributes,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.StartChildWorkflowExecution:
+            txn.add(EventType.StartChildWorkflowExecutionInitiated,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.SignalExternalWorkflowExecution:
+            txn.add(EventType.SignalExternalWorkflowExecutionInitiated,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.RequestCancelExternalWorkflowExecution:
+            txn.add(EventType.RequestCancelExternalWorkflowExecutionInitiated,
+                    decision_task_completed_event_id=completed_id, **a)
+        elif dt == DecisionType.CompleteWorkflowExecution:
+            txn.add(EventType.WorkflowExecutionCompleted,
+                    decision_task_completed_event_id=completed_id, **a)
+            return True
+        elif dt == DecisionType.FailWorkflowExecution:
+            txn.add(EventType.WorkflowExecutionFailed,
+                    decision_task_completed_event_id=completed_id, **a)
+            return True
+        elif dt == DecisionType.CancelWorkflowExecution:
+            txn.add(EventType.WorkflowExecutionCanceled,
+                    decision_task_completed_event_id=completed_id, **a)
+            return True
+        elif dt == DecisionType.ContinueAsNewWorkflowExecution:
+            self._continue_as_new(txn, ms, completed_id, a)
+            return True
+        else:
+            raise InvalidRequestError(f"unknown decision type {dt}")
+        return False
+
+    def _continue_as_new(self, txn: "_Txn", ms: MutableState,
+                         completed_id: int, attrs: Dict[str, Any]) -> None:
+        """AddContinueAsNewEvent (mutable_state_builder.go:3269-3341): close
+        this run and start the chained run in the same commit."""
+        info = ms.execution_info
+        new_run_id = str(uuid.uuid4())
+        txn.add(EventType.WorkflowExecutionContinuedAsNew,
+                new_execution_run_id=new_run_id,
+                decision_task_completed_event_id=completed_id)
+        txn.after_commit(lambda: self._start_continued_run(ms, new_run_id, attrs))
+
+    def _start_continued_run(self, old_ms: MutableState, new_run_id: str,
+                             attrs: Dict[str, Any]) -> None:
+        info = old_ms.execution_info
+        backoff = attrs.get("backoff_start_interval_seconds", 0) or 0
+        self.start_workflow(
+            domain_id=info.domain_id,
+            workflow_id=info.workflow_id,
+            workflow_type=info.workflow_type_name,
+            task_list=attrs.get("task_list", info.task_list),
+            execution_timeout=attrs.get(
+                "execution_start_to_close_timeout_seconds", info.workflow_timeout),
+            decision_timeout=attrs.get(
+                "task_start_to_close_timeout_seconds",
+                info.decision_start_to_close_timeout),
+            cron_schedule=info.cron_schedule,
+            first_decision_backoff=backoff,
+            request_id=f"can-{new_run_id}",
+            # the continued run keeps the workflow ID; a fresh run record is
+            # created because the previous run just closed
+        )
+
+    def fail_decision_task(self, token: TaskToken, cause: str) -> None:
+        """RespondDecisionTaskFailed path."""
+        ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
+        txn = self._new_transaction(ms)
+        txn.add(EventType.DecisionTaskFailed,
+                scheduled_event_id=token.schedule_id,
+                started_event_id=token.started_id, cause=cause)
+        txn.commit(expected)
+
+    # ------------------------------------------------------------------
+    # Activity task lifecycle
+    # ------------------------------------------------------------------
+
+    def record_activity_task_started(self, domain_id: str, workflow_id: str,
+                                     run_id: str, schedule_id: int,
+                                     request_id: str) -> TaskToken:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            raise InvalidRequestError("workflow execution already completed")
+        ai = ms.pending_activity_info_ids.get(schedule_id)
+        if ai is None:
+            raise InvalidRequestError(f"activity {schedule_id} not pending")
+        if ai.started_id != EMPTY_EVENT_ID:
+            raise InvalidRequestError(f"activity {schedule_id} already started")
+        txn = self._new_transaction(ms)
+        started = txn.add(EventType.ActivityTaskStarted,
+                          scheduled_event_id=schedule_id, request_id=request_id)
+        txn.commit(expected)
+        return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
+                         run_id=run_id, schedule_id=schedule_id,
+                         started_id=started.id)
+
+    def _respond_activity(self, token: TaskToken, close_type: EventType,
+                          **extra: Any) -> None:
+        ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            raise InvalidRequestError("workflow execution already completed")
+        ai = ms.pending_activity_info_ids.get(token.schedule_id)
+        if ai is None or ai.started_id != token.started_id:
+            raise InvalidRequestError("activity task no longer current")
+        txn = self._new_transaction(ms)
+        txn.add(close_type, scheduled_event_id=token.schedule_id,
+                started_event_id=token.started_id, **extra)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def respond_activity_task_completed(self, token: TaskToken,
+                                        result: bytes = b"") -> None:
+        self._respond_activity(token, EventType.ActivityTaskCompleted)
+
+    def respond_activity_task_failed(self, token: TaskToken,
+                                     reason: str = "") -> None:
+        self._respond_activity(token, EventType.ActivityTaskFailed, reason=reason)
+
+    def respond_activity_task_canceled(self, token: TaskToken) -> None:
+        self._respond_activity(token, EventType.ActivityTaskCanceled)
+
+    # ------------------------------------------------------------------
+    # Signals / cancel / terminate (historyEngine.go:2202,:2629 region)
+    # ------------------------------------------------------------------
+
+    def signal_workflow(self, domain_id: str, workflow_id: str,
+                        signal_name: str, run_id: Optional[str] = None) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        self._require_running(ms)
+        txn = self._new_transaction(ms)
+        txn.add(EventType.WorkflowExecutionSignaled, signal_name=signal_name)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def request_cancel_workflow(self, domain_id: str, workflow_id: str,
+                                run_id: Optional[str] = None,
+                                cause: str = "") -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        self._require_running(ms)
+        if ms.execution_info.cancel_requested:
+            raise InvalidRequestError("cancellation already requested")
+        txn = self._new_transaction(ms)
+        txn.add(EventType.WorkflowExecutionCancelRequested, cause=cause)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def terminate_workflow(self, domain_id: str, workflow_id: str,
+                           run_id: Optional[str] = None,
+                           reason: str = "") -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        self._require_running(ms)
+        txn = self._new_transaction(ms)
+        txn.add(EventType.WorkflowExecutionTerminated, reason=reason)
+        txn.commit(expected)
+
+    # ------------------------------------------------------------------
+    # Timer-queue callbacks (timer_active_task_executor.go analogs)
+    # ------------------------------------------------------------------
+
+    def fire_user_timer(self, domain_id: str, workflow_id: str, run_id: str,
+                        started_event_id: int) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            return
+        timer_id = ms.pending_timer_event_id_to_id.get(started_event_id)
+        if timer_id is None:
+            return  # already fired/canceled
+        txn = self._new_transaction(ms)
+        txn.add(EventType.TimerFired, timer_id=timer_id,
+                started_event_id=started_event_id)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def activity_timeout(self, domain_id: str, workflow_id: str, run_id: str,
+                         schedule_id: int, timeout_type: int) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            return
+        ai = ms.pending_activity_info_ids.get(schedule_id)
+        if ai is None:
+            return
+        tt = TimeoutType(timeout_type)
+        started = ai.started_id != EMPTY_EVENT_ID
+        # validity per timer type (timer_active_task_executor.go)
+        if tt in (TimeoutType.StartToClose, TimeoutType.Heartbeat) and not started:
+            return
+        if tt == TimeoutType.ScheduleToStart and started:
+            return  # schedule-to-start no longer applicable once started
+        txn = self._new_transaction(ms)
+        txn.add(EventType.ActivityTaskTimedOut, scheduled_event_id=schedule_id,
+                started_event_id=ai.started_id, timeout_type=int(tt))
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def decision_timeout(self, domain_id: str, workflow_id: str, run_id: str,
+                         schedule_id: int, timeout_type: int) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            return
+        if info.decision_schedule_id != schedule_id:
+            return  # decision already completed
+        txn = self._new_transaction(ms)
+        txn.add(EventType.DecisionTaskTimedOut, scheduled_event_id=schedule_id,
+                started_event_id=info.decision_started_id,
+                timeout_type=timeout_type)
+        txn.commit(expected)
+
+    def timeout_workflow(self, domain_id: str, workflow_id: str, run_id: str) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.WorkflowExecutionTimedOut)
+        txn.commit(expected)
+
+    def schedule_first_decision(self, domain_id: str, workflow_id: str,
+                                run_id: str) -> None:
+        """WorkflowBackoffTimer fired (cron/retry start backoff elapsed)."""
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            return
+        if info.decision_schedule_id != EMPTY_EVENT_ID:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                attempt=0)
+        txn.commit(expected)
+
+    # ------------------------------------------------------------------
+    # Cross-workflow deliveries (transfer-queue executors call these)
+    # ------------------------------------------------------------------
+
+    def on_child_started(self, domain_id: str, workflow_id: str, run_id: str,
+                         initiated_id: int, child_run_id: str) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if initiated_id not in ms.pending_child_execution_info_ids:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.ChildWorkflowExecutionStarted,
+                initiated_event_id=initiated_id, run_id=child_run_id)
+        txn.commit(expected)
+
+    def on_child_closed(self, domain_id: str, workflow_id: str, run_id: str,
+                        initiated_id: int, close_event_type: EventType) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        ci = ms.pending_child_execution_info_ids.get(initiated_id)
+        if ci is None or ms.execution_info.state == WorkflowState.Completed:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(close_event_type, initiated_event_id=initiated_id,
+                started_event_id=ci.started_id)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def on_external_signaled(self, domain_id: str, workflow_id: str,
+                             run_id: str, initiated_id: int,
+                             failed: bool = False) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if initiated_id not in ms.pending_signal_info_ids:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.SignalExternalWorkflowExecutionFailed if failed
+                else EventType.ExternalWorkflowExecutionSignaled,
+                initiated_event_id=initiated_id)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    def on_external_cancel_delivered(self, domain_id: str, workflow_id: str,
+                                     run_id: str, initiated_id: int,
+                                     failed: bool = False) -> None:
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        if initiated_id not in ms.pending_request_cancel_info_ids:
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.RequestCancelExternalWorkflowExecutionFailed if failed
+                else EventType.ExternalWorkflowExecutionCancelRequested,
+                initiated_event_id=initiated_id)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def get_mutable_state(self, domain_id: str, workflow_id: str,
+                          run_id: Optional[str] = None) -> MutableState:
+        ms, _ = self._load(domain_id, workflow_id, run_id)
+        return ms
+
+    def get_history(self, domain_id: str, workflow_id: str,
+                    run_id: Optional[str] = None) -> List[HistoryEvent]:
+        if run_id is None:
+            run_id = self.stores.execution.get_current_run_id(domain_id, workflow_id)
+        return self.stores.history.read_events(domain_id, workflow_id, run_id)
+
+    def checksum(self, domain_id: str, workflow_id: str,
+                 run_id: Optional[str] = None) -> Checksum:
+        return Checksum.of(self.get_mutable_state(domain_id, workflow_id, run_id))
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _require_running(ms: MutableState) -> None:
+        if ms.execution_info.state == WorkflowState.Completed:
+            raise EntityNotExistsError("workflow execution already completed")
+
+    @staticmethod
+    def _maybe_schedule_decision(txn: "_Txn", ms: MutableState) -> None:
+        """Schedule a decision when none is pending (the signal/timer/activity
+        completion paths all do this, e.g. historyEngine signal path)."""
+        info = ms.execution_info
+        if info.decision_schedule_id == EMPTY_EVENT_ID:
+            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
+
+
+class _Txn:
+    """One workflow transaction: builds the event batch, applies it through
+    the oracle StateBuilder, persists atomically (context.go:105 analog)."""
+
+    def __init__(self, engine: HistoryEngine, ms: MutableState) -> None:
+        self.engine = engine
+        self.ms = ms
+        self.events: List[HistoryEvent] = []
+        self._next_id = ms.execution_info.next_event_id
+        self._post: List = []
+
+    def add(self, event_type: EventType, **attrs: Any) -> HistoryEvent:
+        ev = HistoryEvent(
+            id=self._next_id, event_type=event_type,
+            version=self.ms.domain_entry.failover_version,
+            timestamp=self.engine.clock.now(),
+            attrs=attrs,
+        )
+        self._next_id += 1
+        self.events.append(ev)
+        return ev
+
+    def after_commit(self, fn) -> None:
+        self._post.append(fn)
+
+    def commit(self, expected_next_event_id: int) -> None:
+        if not self.events:
+            return
+        info = self.ms.execution_info
+        batch = HistoryBatch(domain_id=info.domain_id,
+                             workflow_id=info.workflow_id,
+                             run_id=info.run_id, events=self.events)
+        n_transfer = len(self.ms.transfer_tasks)
+        n_timer = len(self.ms.timer_tasks)
+        StateBuilder(self.ms).apply_batch(batch)
+        self.engine.stores.history.append_batch(
+            info.domain_id, info.workflow_id, info.run_id, self.events)
+        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
+        self.engine.shard.insert_tasks(
+            info.domain_id, info.workflow_id, info.run_id,
+            self.ms.transfer_tasks[n_transfer:], self.ms.timer_tasks[n_timer:])
+        for fn in self._post:
+            fn()

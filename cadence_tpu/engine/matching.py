@@ -1,0 +1,129 @@
+"""Matching engine: task-list dispatch between the history service and
+polling workers.
+
+Reference: service/matching/matchingEngine.go (AddDecisionTask:259,
+AddActivityTask:307, PollForDecisionTask:355, PollForActivityTask:459) and
+taskListManager.go (lease renewal :458, task ID blocks :485, sync-match
+fast path :530). Polls are non-blocking here (the onebox pump loop drives
+them); a poll either sync-matches a buffered task or returns None —
+long-poll parking is a transport concern, not a semantic one.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+from .persistence import PersistedTask, Stores, TaskListInfo
+
+TASK_LIST_TYPE_DECISION = 0
+TASK_LIST_TYPE_ACTIVITY = 1
+
+
+@dataclass
+class MatchedTask:
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    schedule_id: int
+    task_list: str
+
+
+class _TaskListManager:
+    """One task list's buffering + lease (taskListManager.go analog)."""
+
+    def __init__(self, stores: Stores, domain_id: str, name: str,
+                 task_type: int) -> None:
+        self._stores = stores
+        self._info: TaskListInfo = stores.task.lease_task_list(
+            domain_id, name, task_type)
+        self._lock = threading.Lock()
+        self._buffer: Deque[PersistedTask] = deque()
+        self._next_task_id = self._info.range_id * 100000
+        self._ack = 0
+
+    def add(self, domain_id: str, workflow_id: str, run_id: str,
+            schedule_id: int) -> None:
+        with self._lock:
+            self._next_task_id += 1
+            task = PersistedTask(task_id=self._next_task_id, domain_id=domain_id,
+                                 workflow_id=workflow_id, run_id=run_id,
+                                 schedule_id=schedule_id)
+            # write-through (taskWriter batches CreateTasks) then buffer for
+            # dispatch (taskReader pump)
+            self._stores.task.create_tasks(self._info, [task])
+            self._buffer.append(task)
+
+    def poll(self) -> Optional[PersistedTask]:
+        with self._lock:
+            if not self._buffer:
+                return None
+            task = self._buffer.popleft()
+            self._ack = task.task_id
+            self._stores.task.complete_tasks_less_than(
+                self._info.domain_id, self._info.name, self._info.task_type,
+                self._ack)
+            return task
+
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+
+class MatchingEngine:
+    def __init__(self, stores: Stores) -> None:
+        self._stores = stores
+        self._lock = threading.Lock()
+        self._managers: Dict[Tuple[str, str, int], _TaskListManager] = {}
+
+    def _manager(self, domain_id: str, name: str, task_type: int
+                 ) -> _TaskListManager:
+        key = (domain_id, name, task_type)
+        with self._lock:
+            mgr = self._managers.get(key)
+            if mgr is None:
+                mgr = _TaskListManager(self._stores, domain_id, name, task_type)
+                self._managers[key] = mgr
+            return mgr
+
+    # -- adds (called by transfer-queue executors) -------------------------
+
+    def add_decision_task(self, domain_id: str, task_list: str,
+                          workflow_id: str, run_id: str, schedule_id: int) -> None:
+        self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).add(
+            domain_id, workflow_id, run_id, schedule_id)
+
+    def add_activity_task(self, domain_id: str, task_list: str,
+                          workflow_id: str, run_id: str, schedule_id: int) -> None:
+        self._manager(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY).add(
+            domain_id, workflow_id, run_id, schedule_id)
+
+    # -- polls (called by workers via frontend) ----------------------------
+
+    def poll_for_decision_task(self, domain_id: str, task_list: str
+                               ) -> Optional[MatchedTask]:
+        task = self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).poll()
+        if task is None:
+            return None
+        return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
+                           run_id=task.run_id, schedule_id=task.schedule_id,
+                           task_list=task_list)
+
+    def poll_for_activity_task(self, domain_id: str, task_list: str
+                               ) -> Optional[MatchedTask]:
+        task = self._manager(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY).poll()
+        if task is None:
+            return None
+        return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
+                           run_id=task.run_id, schedule_id=task.schedule_id,
+                           task_list=task_list)
+
+    def describe_task_list(self, domain_id: str, task_list: str,
+                           task_type: int) -> Dict[str, int]:
+        mgr = self._manager(domain_id, task_list, task_type)
+        return {"backlog": mgr.backlog()}
+
+    def backlog(self) -> int:
+        with self._lock:
+            return sum(m.backlog() for m in self._managers.values())

@@ -1,0 +1,93 @@
+"""Onebox: a full multi-host cluster in one process.
+
+Reference: host/onebox.go:76 — the integration-test backbone that runs
+history/matching/frontend together against real stores with a static
+membership resolver (host/membership_resolver.go:36-69). Here: N virtual
+history hosts share one store bundle; the hashring assigns shards to hosts;
+a cluster-wide router forwards cross-host calls (standing in for the gRPC
+hop); queue processors and a manual clock drive progress deterministically.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..utils.clock import ManualTimeSource
+from .controller import ShardController, ShardNotOwnedError
+from .frontend import Frontend
+from .history_engine import HistoryEngine
+from .matching import MatchingEngine
+from .membership import HashRing
+from .persistence import Stores
+from .queues import QueueProcessors
+from .tpu_engine import TPUReplayEngine
+
+NANOS = 1_000_000_000
+
+
+class Onebox:
+    def __init__(self, num_hosts: int = 2, num_shards: int = 8) -> None:
+        self.stores = Stores()
+        self.clock = ManualTimeSource()
+        self.hosts = [f"host-{i}" for i in range(num_hosts)]
+        self.ring = HashRing(self.hosts)
+        self.controllers: Dict[str, ShardController] = {
+            h: ShardController(h, num_shards, self.stores, self.ring, self.clock)
+            for h in self.hosts
+        }
+        self.matching = MatchingEngine(self.stores)
+        self.processors = [
+            QueueProcessors(c, self.matching, self.stores, self.clock,
+                            router=self.route)
+            for c in self.controllers.values()
+        ]
+        self.frontend = Frontend(self.stores, self.matching, self.route)
+        self.tpu = TPUReplayEngine(self.stores)
+
+    # -- routing (client/history peer resolver analog) ---------------------
+
+    def route(self, workflow_id: str) -> HistoryEngine:
+        for controller in self.controllers.values():
+            try:
+                return controller.engine_for_workflow(workflow_id)
+            except ShardNotOwnedError:
+                continue
+        raise ShardNotOwnedError(f"no host owns workflows like {workflow_id}")
+
+    # -- cluster dynamics --------------------------------------------------
+
+    def add_host(self, name: str) -> None:
+        controller = ShardController(name, self.controllers[self.hosts[0]].num_shards,
+                                     self.stores, self.ring, self.clock)
+        self.controllers[name] = controller
+        self.hosts.append(name)
+        self.processors.append(QueueProcessors(controller, self.matching,
+                                               self.stores, self.clock,
+                                               router=self.route))
+        self.ring.add_member(name)
+
+    def remove_host(self, name: str) -> None:
+        """Host death: ring change → survivors steal its shards (the ringpop
+        failure-detection → acquireShards path)."""
+        controller = self.controllers.pop(name)
+        self.hosts.remove(name)
+        self.processors = [p for p in self.processors
+                           if p.controller is not controller]
+        self.ring.remove_member(name)
+
+    # -- pumping -----------------------------------------------------------
+
+    def pump_once(self) -> int:
+        done = 0
+        for p in self.processors:
+            done += p.process_transfer_once()
+            done += p.process_timers_once()
+        return done
+
+    def pump_until_quiet(self, max_rounds: int = 200) -> None:
+        for _ in range(max_rounds):
+            if self.pump_once() == 0 and self.matching.backlog() == 0:
+                return
+        raise RuntimeError("cluster did not quiesce")
+
+    def advance_time(self, seconds: float) -> None:
+        self.clock.advance(int(seconds * NANOS))

@@ -1,0 +1,490 @@
+"""Persistence layer: stores + conditional-update fencing.
+
+The reference's persistence stack (common/persistence/dataStoreInterfaces.go
+ExecutionStore/HistoryStore/TaskStore/ShardStore/DomainStore/QueueStore, with
+nosql/sql backends) reduced to its semantic contract:
+
+- every shard write is fenced by the owner's range ID
+  (shard/context.go:586-700): a stale owner's writes fail with
+  ShardOwnershipLostError and it must self-close;
+- workflow-execution updates are conditional on the next-event-id read in
+  the same transaction (mutable_state_builder.go:129-130 nextEventIDInDB),
+  failing with ConditionFailedError on concurrent modification;
+- per workflow ID there is one current run (executionManager.go current
+  execution record);
+- history is an append-only sequence of event batches per run
+  (historyManager.go tree/branch model; single branch here — the NDC
+  branch tree arrives with the replication layer).
+
+The in-memory backend is the reference's "nosql plugin" seat; the on-disk
+JSONL backend (FileHistoryStore) is for durability tests and bench corpora.
+All stores are thread-safe.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core.events import HistoryBatch, HistoryEvent
+from ..oracle.mutable_state import MutableState
+
+
+class ConditionFailedError(Exception):
+    """Conditional update lost (persistence ConditionFailedError)."""
+
+
+class ShardOwnershipLostError(Exception):
+    """Range-ID fence rejected the write (persistence ShardOwnershipLostError)."""
+
+
+class WorkflowAlreadyStartedError(Exception):
+    """Current run exists and is open (WorkflowExecutionAlreadyStartedError)."""
+
+
+class EntityNotExistsError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Shard store (ShardManager, dataManagerInterfaces.go:1688; ShardInfo :275)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShardInfo:
+    shard_id: int
+    owner: str = ""
+    range_id: int = 0
+    transfer_ack_level: int = 0
+    timer_ack_level: int = 0  # nanos
+    replication_ack_level: int = 0
+    stolen_since_renew: int = 0
+
+
+class ShardStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._shards: Dict[int, ShardInfo] = {}
+
+    def get_or_create(self, shard_id: int) -> ShardInfo:
+        with self._lock:
+            if shard_id not in self._shards:
+                self._shards[shard_id] = ShardInfo(shard_id=shard_id)
+            s = self._shards[shard_id]
+            return ShardInfo(**vars(s))
+
+    def update(self, info: ShardInfo, expected_range_id: int) -> None:
+        """Conditional on the previous range ID (renewRangeLocked fencing,
+        shard/context.go:1068)."""
+        with self._lock:
+            cur = self._shards.get(info.shard_id)
+            if cur is None or cur.range_id != expected_range_id:
+                raise ShardOwnershipLostError(
+                    f"shard {info.shard_id}: expected range {expected_range_id}, "
+                    f"have {cur.range_id if cur else None}"
+                )
+            self._shards[info.shard_id] = ShardInfo(**vars(info))
+
+
+# ---------------------------------------------------------------------------
+# History store (HistoryManager, dataManagerInterfaces.go:1764; append
+# AppendHistoryNodes nosqlHistoryStore.go:76, read ReadHistoryBranchByBatch)
+# ---------------------------------------------------------------------------
+
+
+class HistoryStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: (domain_id, workflow_id, run_id) -> list of event batches
+        self._branches: Dict[Tuple[str, str, str], List[List[HistoryEvent]]] = {}
+
+    def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
+                     events: List[HistoryEvent]) -> None:
+        if not events:
+            raise ValueError("empty history batch")
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branch = self._branches.setdefault(key, [])
+            if branch:
+                expected = branch[-1][-1].id + 1
+                if events[0].id != expected:
+                    raise ConditionFailedError(
+                        f"history append out of order: got first id "
+                        f"{events[0].id}, expected {expected}"
+                    )
+            branch.append(list(events))
+
+    def read_batches(self, domain_id: str, workflow_id: str, run_id: str
+                     ) -> List[List[HistoryEvent]]:
+        with self._lock:
+            branch = self._branches.get((domain_id, workflow_id, run_id))
+            if branch is None:
+                raise EntityNotExistsError(f"no history for {workflow_id}/{run_id}")
+            return [list(b) for b in branch]
+
+    def read_events(self, domain_id: str, workflow_id: str, run_id: str
+                    ) -> List[HistoryEvent]:
+        return [e for b in self.read_batches(domain_id, workflow_id, run_id)
+                for e in b]
+
+    def as_history_batches(self, domain_id: str, workflow_id: str, run_id: str
+                           ) -> List[HistoryBatch]:
+        """Batches in the replay-input shape (for the TPU kernel path)."""
+        return [
+            HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
+                         run_id=run_id, events=b)
+            for b in self.read_batches(domain_id, workflow_id, run_id)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Execution store (ExecutionManager, dataManagerInterfaces.go:1697)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CurrentExecution:
+    run_id: str
+    state: int
+    close_status: int
+
+
+class ExecutionStore:
+    """Mutable-state snapshots + current-run pointers, with conditional
+    updates on next_event_id and range-ID fencing."""
+
+    def __init__(self, shard_store: ShardStore) -> None:
+        self._lock = threading.Lock()
+        self._shard_store = shard_store
+        #: (domain_id, workflow_id, run_id) -> (MutableState, checksum value)
+        self._executions: Dict[Tuple[str, str, str], MutableState] = {}
+        #: (domain_id, workflow_id) -> CurrentExecution
+        self._current: Dict[Tuple[str, str], CurrentExecution] = {}
+
+    def _check_fence(self, shard_id: int, range_id: int) -> None:
+        cur = self._shard_store.get_or_create(shard_id)
+        if cur.range_id != range_id:
+            raise ShardOwnershipLostError(
+                f"shard {shard_id}: write fenced (range {range_id} != {cur.range_id})"
+            )
+
+    def create_workflow(self, shard_id: int, range_id: int, ms: MutableState) -> None:
+        """CreateWorkflowExecution (shard/context.go:586): fails when a
+        current run exists and is still open."""
+        info = ms.execution_info
+        key = (info.domain_id, info.workflow_id, info.run_id)
+        cur_key = (info.domain_id, info.workflow_id)
+        with self._lock:
+            self._check_fence(shard_id, range_id)
+            cur = self._current.get(cur_key)
+            from ..core.enums import WorkflowState
+            if cur is not None and cur.state != WorkflowState.Completed:
+                raise WorkflowAlreadyStartedError(
+                    f"{info.workflow_id}: run {cur.run_id} still open"
+                )
+            self._executions[key] = ms
+            self._current[cur_key] = CurrentExecution(
+                run_id=info.run_id, state=info.state, close_status=info.close_status
+            )
+
+    def update_workflow(self, shard_id: int, range_id: int, ms: MutableState,
+                        expected_next_event_id: int) -> None:
+        """UpdateWorkflowExecution (shard/context.go:696): conditional on the
+        next-event-id recorded when the transaction loaded the state."""
+        info = ms.execution_info
+        key = (info.domain_id, info.workflow_id, info.run_id)
+        with self._lock:
+            self._check_fence(shard_id, range_id)
+            existing = self._executions.get(key)
+            if existing is None:
+                raise EntityNotExistsError(f"no execution {key}")
+            if existing.execution_info.next_event_id != expected_next_event_id:
+                raise ConditionFailedError(
+                    f"{info.workflow_id}: next_event_id "
+                    f"{existing.execution_info.next_event_id} != expected "
+                    f"{expected_next_event_id}"
+                )
+            self._executions[key] = ms
+            cur_key = (info.domain_id, info.workflow_id)
+            cur = self._current.get(cur_key)
+            if cur is not None and cur.run_id == info.run_id:
+                self._current[cur_key] = CurrentExecution(
+                    run_id=info.run_id, state=info.state,
+                    close_status=info.close_status,
+                )
+
+    def get_workflow(self, domain_id: str, workflow_id: str, run_id: str
+                     ) -> MutableState:
+        with self._lock:
+            ms = self._executions.get((domain_id, workflow_id, run_id))
+            if ms is None:
+                raise EntityNotExistsError(f"no execution {workflow_id}/{run_id}")
+            return ms
+
+    def get_current_run_id(self, domain_id: str, workflow_id: str) -> str:
+        with self._lock:
+            cur = self._current.get((domain_id, workflow_id))
+            if cur is None:
+                raise EntityNotExistsError(f"no current execution {workflow_id}")
+            return cur.run_id
+
+    def list_executions(self) -> List[Tuple[str, str, str]]:
+        with self._lock:
+            return list(self._executions.keys())
+
+
+# ---------------------------------------------------------------------------
+# Task store (TaskManager, dataManagerInterfaces.go:1749; matching
+# taskListManager lease + task id blocks)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TaskListInfo:
+    domain_id: str
+    name: str
+    task_type: int  # TaskListTypeDecision / TaskListTypeActivity
+    range_id: int = 0
+    ack_level: int = 0
+
+
+@dataclass
+class PersistedTask:
+    task_id: int
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    schedule_id: int
+
+
+class TaskStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tasklists: Dict[Tuple[str, str, int], TaskListInfo] = {}
+        self._tasks: Dict[Tuple[str, str, int], List[PersistedTask]] = {}
+
+    def lease_task_list(self, domain_id: str, name: str, task_type: int
+                        ) -> TaskListInfo:
+        """LeaseTaskList: bump range id, invalidating previous lessee
+        (matching/taskListManager.go renewLeaseWithRetry:458)."""
+        key = (domain_id, name, task_type)
+        with self._lock:
+            info = self._tasklists.setdefault(
+                key, TaskListInfo(domain_id=domain_id, name=name, task_type=task_type)
+            )
+            info.range_id += 1
+            return TaskListInfo(**vars(info))
+
+    def create_tasks(self, info: TaskListInfo, tasks: List[PersistedTask]) -> None:
+        key = (info.domain_id, info.name, info.task_type)
+        with self._lock:
+            cur = self._tasklists.get(key)
+            if cur is None or cur.range_id != info.range_id:
+                raise ConditionFailedError(
+                    f"task list {info.name}: lease lost"
+                )
+            self._tasks.setdefault(key, []).extend(tasks)
+
+    def get_tasks(self, domain_id: str, name: str, task_type: int,
+                  min_task_id: int, batch_size: int = 100) -> List[PersistedTask]:
+        key = (domain_id, name, task_type)
+        with self._lock:
+            return [t for t in self._tasks.get(key, [])
+                    if t.task_id > min_task_id][:batch_size]
+
+    def complete_tasks_less_than(self, domain_id: str, name: str,
+                                 task_type: int, task_id: int) -> int:
+        key = (domain_id, name, task_type)
+        with self._lock:
+            tasks = self._tasks.get(key, [])
+            keep = [t for t in tasks if t.task_id > task_id]
+            removed = len(tasks) - len(keep)
+            self._tasks[key] = keep
+            return removed
+
+
+# ---------------------------------------------------------------------------
+# Domain store (DomainManager, dataManagerInterfaces.go:1793)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DomainInfo:
+    domain_id: str
+    name: str
+    retention_days: int = 1
+    is_active: bool = True
+    active_cluster: str = "primary"
+    clusters: Tuple[str, ...] = ("primary",)
+    failover_version: int = 0
+    notification_version: int = 0
+
+
+class DomainStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_id: Dict[str, DomainInfo] = {}
+        self._by_name: Dict[str, str] = {}
+
+    def register(self, info: DomainInfo) -> None:
+        with self._lock:
+            if info.name in self._by_name:
+                raise WorkflowAlreadyStartedError(f"domain {info.name} exists")
+            self._by_id[info.domain_id] = info
+            self._by_name[info.name] = info.domain_id
+
+    def by_name(self, name: str) -> DomainInfo:
+        with self._lock:
+            domain_id = self._by_name.get(name)
+            if domain_id is None:
+                raise EntityNotExistsError(f"domain {name}")
+            return self._by_id[domain_id]
+
+    def by_id(self, domain_id: str) -> DomainInfo:
+        with self._lock:
+            info = self._by_id.get(domain_id)
+            if info is None:
+                raise EntityNotExistsError(f"domain id {domain_id}")
+            return info
+
+    def update(self, info: DomainInfo) -> None:
+        with self._lock:
+            self._by_id[info.domain_id] = info
+
+    def list_domains(self) -> List[DomainInfo]:
+        with self._lock:
+            return list(self._by_id.values())
+
+
+# ---------------------------------------------------------------------------
+# Visibility store (VisibilityManager analog; ES/SQL dual manager later)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VisibilityRecord:
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    workflow_type: str
+    start_time: int
+    close_time: int = 0
+    close_status: int = -1  # -1 = open
+
+
+class VisibilityStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: Dict[Tuple[str, str, str], VisibilityRecord] = {}
+
+    def record_started(self, rec: VisibilityRecord) -> None:
+        with self._lock:
+            self._records[(rec.domain_id, rec.workflow_id, rec.run_id)] = rec
+
+    def record_closed(self, domain_id: str, workflow_id: str, run_id: str,
+                      close_time: int, close_status: int) -> None:
+        with self._lock:
+            rec = self._records.get((domain_id, workflow_id, run_id))
+            if rec is not None:
+                rec.close_time = close_time
+                rec.close_status = close_status
+
+    def list_open(self, domain_id: str) -> List[VisibilityRecord]:
+        with self._lock:
+            return [r for r in self._records.values()
+                    if r.domain_id == domain_id and r.close_status == -1]
+
+    def list_closed(self, domain_id: str) -> List[VisibilityRecord]:
+        with self._lock:
+            return [r for r in self._records.values()
+                    if r.domain_id == domain_id and r.close_status != -1]
+
+
+# ---------------------------------------------------------------------------
+# Queue store (QueueManager, dataManagerInterfaces.go:1806 — replication/DLQ)
+# ---------------------------------------------------------------------------
+
+
+class QueueStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[object]] = {}
+
+    def enqueue(self, queue: str, payload: object) -> int:
+        with self._lock:
+            q = self._queues.setdefault(queue, [])
+            q.append(payload)
+            return len(q) - 1
+
+    def read(self, queue: str, from_index: int, count: int = 100
+             ) -> List[Tuple[int, object]]:
+        with self._lock:
+            q = self._queues.get(queue, [])
+            return [(i, q[i]) for i in range(from_index, min(len(q), from_index + count))]
+
+
+class ShardTaskQueues:
+    """Durable per-shard transfer/timer task queues.
+
+    In the reference these rows live in the executions table and are read
+    via ExecutionManager.GetTransferTasks / GetTimerIndexTasks
+    (dataManagerInterfaces.go:1712,:1732); keeping them in the store — not
+    in the shard context — is what lets a new owner resume a dead host's
+    queue processing from the persisted ack level."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._transfer: Dict[int, List[tuple]] = {}
+        self._timer: Dict[int, List[tuple]] = {}
+
+    def insert_transfer(self, shard_id: int, rows: Iterable[tuple]) -> None:
+        with self._lock:
+            self._transfer.setdefault(shard_id, []).extend(rows)
+
+    def insert_timer(self, shard_id: int, rows: Iterable[tuple]) -> None:
+        with self._lock:
+            self._timer.setdefault(shard_id, []).extend(rows)
+
+    def read_transfer(self, shard_id: int, ack_level: int,
+                      batch: int = 100) -> List[tuple]:
+        with self._lock:
+            return [t for t in self._transfer.get(shard_id, [])
+                    if t[0] > ack_level][:batch]
+
+    def read_timer_due(self, shard_id: int, now_nanos: int,
+                       batch: int = 100) -> List[tuple]:
+        with self._lock:
+            due = [t for t in self._timer.get(shard_id, []) if t[0] <= now_nanos]
+            due.sort(key=lambda t: (t[0], t[1]))
+            return due[:batch]
+
+    def complete_transfer_below(self, shard_id: int, level: int) -> None:
+        with self._lock:
+            self._transfer[shard_id] = [
+                t for t in self._transfer.get(shard_id, []) if t[0] > level
+            ]
+
+    def complete_timer(self, shard_id: int, task_id: int) -> None:
+        with self._lock:
+            self._timer[shard_id] = [
+                t for t in self._timer.get(shard_id, []) if t[1] != task_id
+            ]
+
+
+@dataclass
+class Stores:
+    """One bundle per "cluster" (resource.Resource analog)."""
+
+    shard: ShardStore = field(default_factory=ShardStore)
+    history: HistoryStore = field(default_factory=HistoryStore)
+    task: TaskStore = field(default_factory=TaskStore)
+    domain: DomainStore = field(default_factory=DomainStore)
+    visibility: VisibilityStore = field(default_factory=VisibilityStore)
+    queue: QueueStore = field(default_factory=QueueStore)
+    shard_tasks: ShardTaskQueues = field(default_factory=ShardTaskQueues)
+    execution: ExecutionStore = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.execution is None:
+            self.execution = ExecutionStore(self.shard)

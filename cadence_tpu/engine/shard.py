@@ -1,0 +1,145 @@
+"""Shard context: per-shard metadata, task ID allocation, range-ID fencing.
+
+Reference: service/history/shard/context.go — the shard owns a range ID
+renewed on acquisition (renewRangeLocked:1068); every persistence write is
+fenced by it so a stale owner self-closes; transfer task IDs are allocated
+from range-scoped blocks (GenerateTransferTaskID:68); ack levels checkpoint
+queue progress in ShardInfo (dataManagerInterfaces.go:275-295).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..oracle.mutable_state import GeneratedTask, MutableState
+from .persistence import ShardInfo, ShardOwnershipLostError, Stores
+
+# rangeSizeBits analog: each range owns this many task IDs
+RANGE_SIZE = 1 << 20
+
+
+class ShardContext:
+    def __init__(self, shard_id: int, owner: str, stores: Stores) -> None:
+        self.shard_id = shard_id
+        self.owner = owner
+        self._stores = stores
+        self._lock = threading.RLock()
+        self._info: Optional[ShardInfo] = None
+        self._next_task_id = 0
+        self._max_task_id = 0
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def acquire(self) -> None:
+        """Take ownership: bump range ID (renewRangeLocked)."""
+        with self._lock:
+            info = self._stores.shard.get_or_create(self.shard_id)
+            prev_range = info.range_id
+            info.range_id += 1
+            info.owner = self.owner
+            self._stores.shard.update(info, expected_range_id=prev_range)
+            self._info = info
+            self._next_task_id = info.range_id * RANGE_SIZE
+            self._max_task_id = (info.range_id + 1) * RANGE_SIZE
+            self._closed = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    @property
+    def range_id(self) -> int:
+        with self._lock:
+            self._ensure_open()
+            return self._info.range_id
+
+    def _ensure_open(self) -> None:
+        if self._closed or self._info is None:
+            raise ShardOwnershipLostError(f"shard {self.shard_id} closed")
+
+    # -- task IDs ----------------------------------------------------------
+
+    def generate_task_id(self) -> int:
+        """GenerateTransferTaskID: monotonic within the owned range."""
+        with self._lock:
+            self._ensure_open()
+            if self._next_task_id >= self._max_task_id:
+                # renew range for a fresh block (renewRangeLocked on exhaustion)
+                self.acquire()
+            tid = self._next_task_id
+            self._next_task_id += 1
+            return tid
+
+    # -- fenced persistence ------------------------------------------------
+
+    def create_workflow(self, ms: MutableState) -> None:
+        with self._lock:
+            self._ensure_open()
+            try:
+                self._stores.execution.create_workflow(
+                    self.shard_id, self._info.range_id, ms
+                )
+            except ShardOwnershipLostError:
+                self._closed = True
+                raise
+
+    def update_workflow(self, ms: MutableState, expected_next_event_id: int) -> None:
+        with self._lock:
+            self._ensure_open()
+            try:
+                self._stores.execution.update_workflow(
+                    self.shard_id, self._info.range_id, ms, expected_next_event_id
+                )
+            except ShardOwnershipLostError:
+                self._closed = True
+                raise
+
+    # -- shard task queues -------------------------------------------------
+
+    def insert_tasks(self, domain_id: str, workflow_id: str, run_id: str,
+                     transfer: List[GeneratedTask],
+                     timer: List[GeneratedTask]) -> None:
+        """Persist generated tasks into the shard's durable queues, stamping
+        task IDs (shard/context.go allocates task IDs inside the update
+        transaction); rows survive this owner's death."""
+        with self._lock:
+            self._ensure_open()
+            self._stores.shard_tasks.insert_transfer(self.shard_id, [
+                (self.generate_task_id(), domain_id, workflow_id, run_id, t)
+                for t in transfer
+            ])
+            self._stores.shard_tasks.insert_timer(self.shard_id, [
+                (t.visibility_timestamp, self.generate_task_id(),
+                 domain_id, workflow_id, run_id, t)
+                for t in timer
+            ])
+
+    def read_transfer_tasks(self, ack_level: int, batch: int = 100) -> List[tuple]:
+        return self._stores.shard_tasks.read_transfer(self.shard_id, ack_level,
+                                                      batch)
+
+    def read_timer_tasks(self, now_nanos: int, ack_level: int,
+                         batch: int = 100) -> List[tuple]:
+        return self._stores.shard_tasks.read_timer_due(self.shard_id, now_nanos,
+                                                       batch)
+
+    def update_transfer_ack_level(self, level: int) -> None:
+        with self._lock:
+            self._ensure_open()
+            info = self._info
+            info.transfer_ack_level = max(info.transfer_ack_level, level)
+            self._stores.shard.update(info, expected_range_id=info.range_id)
+            self._stores.shard_tasks.complete_transfer_below(self.shard_id,
+                                                             info.transfer_ack_level)
+
+    def update_timer_ack_level(self, task_id: int) -> None:
+        with self._lock:
+            self._ensure_open()
+            self._stores.shard_tasks.complete_timer(self.shard_id, task_id)
+
+    @property
+    def transfer_ack_level(self) -> int:
+        with self._lock:
+            self._ensure_open()
+            return self._info.transfer_ack_level

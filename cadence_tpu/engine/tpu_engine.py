@@ -1,0 +1,97 @@
+"""The TPU execution-engine plugin: bulk replay/verify on device.
+
+This is the north-star component (BASELINE.json): alongside the per-workflow
+engine path, a bulk path that reads MANY workflows' persisted histories,
+packs them, replays them in lockstep on the accelerator, and compares the
+resulting canonical checksum payloads against the live mutable states.
+
+Reference seams it occupies:
+- EngineFactory (shard/controller.go:55-58): constructed per controller and
+  offered through it;
+- stateRebuilder.Rebuild (execution/state_rebuilder.go:102): the bulk
+  analog of single-workflow rebuild;
+- scanner/reconciliation (common/reconciliation/invariant): verify_all is a
+  concrete-execution invariant check executed on device;
+- the mutable-state checksum (execution/checksum.go:36) is the comparison
+  oracle on both sides.
+
+Workflows whose histories exceed kernel capacities (pending tables, event
+length) or trip the error flag fall back to the per-workflow oracle path —
+measured and reported, never silent.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout, payload_row
+from ..oracle.state_builder import StateBuilder
+from .persistence import Stores
+
+
+@dataclass
+class BulkVerifyResult:
+    total: int
+    verified_on_device: int
+    divergent: List[Tuple[str, str, str]] = field(default_factory=list)
+    fallback: List[Tuple[str, str, str]] = field(default_factory=list)
+    device_errors: List[Tuple[Tuple[str, str, str], int]] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergent
+
+
+class TPUReplayEngine:
+    """Bulk device replay over persisted histories."""
+
+    def __init__(self, stores: Stores,
+                 layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
+        self.stores = stores
+        self.layout = layout
+
+    def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
+        return [
+            self.stores.history.as_history_batches(*key) for key in keys
+        ]
+
+    def replay_payloads(self, keys: Sequence[Tuple[str, str, str]]
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Device-replay the given executions; returns (payload rows, errors)."""
+        from ..ops.replay import replay_corpus
+
+        histories = self._load_histories(keys)
+        rows, _crcs, errors = replay_corpus(histories, self.layout)
+        return rows, errors
+
+    def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
+                   ) -> BulkVerifyResult:
+        """Replay persisted histories on device and compare against the live
+        mutable states (zero-divergence contract). Errored rows are re-run
+        through the oracle (per-workflow fallback path)."""
+        if keys is None:
+            keys = self.stores.execution.list_executions()
+        keys = list(keys)
+        if not keys:
+            return BulkVerifyResult(total=0, verified_on_device=0)
+        rows, errors = self.replay_payloads(keys)
+
+        result = BulkVerifyResult(total=len(keys), verified_on_device=0)
+        for i, key in enumerate(keys):
+            live_ms = self.stores.execution.get_workflow(*key)
+            expected = payload_row(live_ms, self.layout)
+            if errors[i] != 0:
+                # device flagged this workflow: oracle fallback
+                result.device_errors.append((key, int(errors[i])))
+                result.fallback.append(key)
+                oracle_ms = StateBuilder().replay_history(
+                    self.stores.history.as_history_batches(*key))
+                if not (payload_row(oracle_ms, self.layout) == expected).all():
+                    result.divergent.append(key)
+            else:
+                result.verified_on_device += 1
+                if not (rows[i] == expected).all():
+                    result.divergent.append(key)
+        return result

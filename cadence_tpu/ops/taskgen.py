@@ -23,8 +23,11 @@ from typing import NamedTuple, Tuple
 import jax.numpy as jnp
 
 from ..core.enums import (
+    CLOSE_EVENT_STATUS,
     EMPTY_EVENT_ID,
     NANOS_PER_SECOND,
+    TIMER_TASK_STATUS_CREATED,
+    TIMER_TYPE_TO_STATUS_MASK,
     EventType,
     TimeoutType,
     TimerTaskType,
@@ -40,15 +43,10 @@ from .encode import (
     LANE_VERSION,
 )
 from .state import ReplayState
+from .transitions import _scatter as _w  # same masked one-hot write rule
 
 _I64 = jnp.int64
 _DAY_NANOS = 24 * 3600 * NANOS_PER_SECOND
-
-# decision retry backoff constants (task_generator.go:119-121); jitter draw
-# fixed to 0, matching oracle/task_generator.get_next_decision_timeout_nanos
-_DECISION_RETRY_INIT_NANOS = 60 * NANOS_PER_SECOND
-_DECISION_RETRY_MAX_NANOS = 300 * NANOS_PER_SECOND
-_DECISION_RETRY_KEEP = 0.8  # 1 - defaultJitterCoefficient
 
 
 class TaskLog(NamedTuple):
@@ -89,9 +87,6 @@ def _emit(count, overflow, cap, mask):
     do = mask & ~full
     onehot = (jnp.arange(cap)[None, :] == count[:, None]) & do[:, None]
     return onehot, count + do.astype(_I64), overflow | (mask & full)
-
-
-from .transitions import _scatter as _w  # same masked one-hot write rule
 
 
 def emit_transfer(log: TaskLog, mask, ttype, version, event_id) -> TaskLog:
@@ -171,9 +166,9 @@ def batch_end_timer_tasks(s: ReplayState, log: TaskLog,
     cand_type = jnp.concatenate([
         jnp.full((W, K), int(t), _I64) for t in type_codes
     ], axis=1)
-    status_bits = [4, 2, 1, 8]  # TIMER_TASK_STATUS_CREATED_* per quadrant
     cand_bit = jnp.concatenate([
-        jnp.full((W, K), b, jnp.int32) for b in status_bits
+        jnp.full((W, K), TIMER_TYPE_TO_STATUS_MASK[t], jnp.int32)
+        for t in type_codes
     ], axis=1)
     cand_created = (jnp.tile(act.timer_status, (1, 4)) & cand_bit) > 0
 
@@ -201,7 +196,7 @@ def batch_end_timer_tasks(s: ReplayState, log: TaskLog,
 
     # user timers (timer_sequence.go:127-160): single candidate per timer
     tmr = s.timers
-    created = tmr.task_status == 1
+    created = tmr.task_status == TIMER_TASK_STATUS_CREATED
     found, sel = _lex_min3(tmr.occ & mask[:, None], tmr.expiry_time,
                            tmr.started_id,
                            jnp.zeros_like(tmr.started_id))
@@ -210,7 +205,8 @@ def batch_end_timer_tasks(s: ReplayState, log: TaskLog,
     sel_ts = jnp.where(sel, tmr.expiry_time, 0).sum(axis=1)
     sel_eid = jnp.where(sel, tmr.started_id, 0).sum(axis=1)
     tmr = tmr._replace(
-        task_status=jnp.where(sel, jnp.int32(1), tmr.task_status)
+        task_status=jnp.where(sel, jnp.int32(TIMER_TASK_STATUS_CREATED),
+                              tmr.task_status)
     )
     log = emit_timer(
         log, fresh, jnp.int64(TimerTaskType.UserTimer),
@@ -306,12 +302,7 @@ def step_tasks(s_new: ReplayState, ev: jnp.ndarray,
     # --- close events: CloseExecution transfer + retention deletion timer
     # (task_generator.go:168-258, passive path)
     m_close = jnp.zeros_like(ok)
-    for et in (EventType.WorkflowExecutionCompleted,
-               EventType.WorkflowExecutionFailed,
-               EventType.WorkflowExecutionTimedOut,
-               EventType.WorkflowExecutionCanceled,
-               EventType.WorkflowExecutionTerminated,
-               EventType.WorkflowExecutionContinuedAsNew):
+    for et, _status in CLOSE_EVENT_STATUS:
         m_close = m_close | m(et)
     log = emit_transfer(log, m_close, jnp.int64(TransferTaskType.CloseExecution),
                         ev_version, jnp.zeros_like(ev_id))

@@ -20,6 +20,7 @@ from typing import Tuple
 import jax.numpy as jnp
 
 from ..core.enums import (
+    CLOSE_EVENT_STATUS,
     EMPTY_EVENT_ID,
     EMPTY_VERSION,
     NANOS_PER_SECOND,
@@ -471,17 +472,9 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     cancel_requested = s.cancel_requested | m(EventType.WorkflowExecutionCancelRequested)
 
     # Close events (:2561-2655, :2719-2733, :3225-3240, :3366-3382)
-    close_specs = (
-        (EventType.WorkflowExecutionCompleted, CloseStatus.Completed),
-        (EventType.WorkflowExecutionFailed, CloseStatus.Failed),
-        (EventType.WorkflowExecutionTimedOut, CloseStatus.TimedOut),
-        (EventType.WorkflowExecutionCanceled, CloseStatus.Canceled),
-        (EventType.WorkflowExecutionTerminated, CloseStatus.Terminated),
-        (EventType.WorkflowExecutionContinuedAsNew, CloseStatus.ContinuedAsNew),
-    )
     m_close = jnp.zeros_like(live)
     close_val = jnp.zeros_like(s.close_status)
-    for et, cs in close_specs:
+    for et, cs in CLOSE_EVENT_STATUS:
         mm = m(et)
         m_close = m_close | mm
         close_val = jnp.where(mm, jnp.int32(cs), close_val)

@@ -1,0 +1,51 @@
+"""Worker simulation: hand-rolled poll/respond loops.
+
+Reference: host/taskpoller.go — integration tests drive workers by polling
+decision/activity tasks directly and responding, with no SDK in between.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from cadence_tpu.engine.onebox import Onebox
+
+
+class TaskPoller:
+    def __init__(self, box: Onebox, domain: str, task_list: str,
+                 deciders: Dict[str, object]) -> None:
+        """`deciders` maps workflow_id → decider object with .decide(history)."""
+        self.box = box
+        self.domain = domain
+        self.task_list = task_list
+        self.deciders = deciders
+
+    def poll_and_decide_once(self) -> bool:
+        resp = self.box.frontend.poll_for_decision_task(self.domain, self.task_list)
+        if resp is None:
+            return False
+        decider = self.deciders[resp.token.workflow_id]
+        decisions = decider.decide(resp.history)
+        self.box.frontend.respond_decision_task_completed(resp.token, decisions)
+        return True
+
+    def poll_and_run_activity_once(self, fail: bool = False) -> bool:
+        resp = self.box.frontend.poll_for_activity_task(self.domain, self.task_list)
+        if resp is None:
+            return False
+        if fail:
+            self.box.frontend.respond_activity_task_failed(resp.token, "boom")
+        else:
+            self.box.frontend.respond_activity_task_completed(resp.token)
+        return True
+
+    def drain(self, max_rounds: int = 500) -> None:
+        """Pump queues + worker polls until the cluster goes quiet."""
+        for _ in range(max_rounds):
+            progressed = self.box.pump_once() > 0
+            while self.poll_and_decide_once():
+                progressed = True
+            while self.poll_and_run_activity_once():
+                progressed = True
+            if not progressed and self.box.matching.backlog() == 0:
+                return
+        raise RuntimeError("cluster did not drain")
