@@ -115,8 +115,9 @@ class HistoryEngine:
                        first_decision_backoff: int = 0,
                        retry_policy: Optional[RetryPolicy] = None,
                        parent: Optional[Dict[str, Any]] = None,
-                       request_id: Optional[str] = None) -> str:
-        run_id = str(uuid.uuid4())
+                       request_id: Optional[str] = None,
+                       run_id: Optional[str] = None) -> str:
+        run_id = run_id or str(uuid.uuid4())
         ms = MutableState(self._domain_entry(domain_id))
         now = self.clock.now()
         start_attrs: Dict[str, Any] = dict(
@@ -230,13 +231,20 @@ class HistoryEngine:
         a = d.attrs
         dt = d.decision_type
         if dt == DecisionType.ScheduleActivityTask:
-            if a.get("activity_id") in ms.pending_activity_id_to_event_id:
-                raise InvalidRequestError(f"duplicate activity {a.get('activity_id')}")
+            aid = a.get("activity_id")
+            # check both committed state and this batch's earlier decisions
+            # (decision/checker.go validates per-request, not just per-state)
+            if (aid in ms.pending_activity_id_to_event_id
+                    or aid in txn.added_activity_ids):
+                raise InvalidRequestError(f"duplicate activity {aid}")
+            txn.added_activity_ids.add(aid)
             txn.add(EventType.ActivityTaskScheduled,
                     decision_task_completed_event_id=completed_id, **a)
         elif dt == DecisionType.StartTimer:
-            if a.get("timer_id") in ms.pending_timer_info_ids:
-                raise InvalidRequestError(f"duplicate timer {a.get('timer_id')}")
+            tid = a.get("timer_id")
+            if tid in ms.pending_timer_info_ids or tid in txn.added_timer_ids:
+                raise InvalidRequestError(f"duplicate timer {tid}")
+            txn.added_timer_ids.add(tid)
             txn.add(EventType.TimerStarted,
                     decision_task_completed_event_id=completed_id, **a)
         elif dt == DecisionType.CancelTimer:
@@ -319,8 +327,10 @@ class HistoryEngine:
             cron_schedule=info.cron_schedule,
             first_decision_backoff=backoff,
             request_id=f"can-{new_run_id}",
-            # the continued run keeps the workflow ID; a fresh run record is
-            # created because the previous run just closed
+            # the continued run keeps the workflow ID and MUST use the run ID
+            # recorded in the ContinuedAsNew event, or the persisted chain
+            # would point at a nonexistent run
+            run_id=new_run_id,
         )
 
     def fail_decision_task(self, token: TaskToken, cause: str) -> None:
@@ -591,6 +601,9 @@ class _Txn:
         self.events: List[HistoryEvent] = []
         self._next_id = ms.execution_info.next_event_id
         self._post: List = []
+        #: IDs introduced earlier in this batch (pre-commit dedup)
+        self.added_activity_ids: set = set()
+        self.added_timer_ids: set = set()
 
     def add(self, event_type: EventType, **attrs: Any) -> HistoryEvent:
         ev = HistoryEvent(
@@ -613,14 +626,22 @@ class _Txn:
         batch = HistoryBatch(domain_id=info.domain_id,
                              workflow_id=info.workflow_id,
                              run_id=info.run_id, events=self.events)
-        n_transfer = len(self.ms.transfer_tasks)
-        n_timer = len(self.ms.timer_tasks)
         StateBuilder(self.ms).apply_batch(batch)
+        new_transfer = list(self.ms.transfer_tasks)
+        new_timer = list(self.ms.timer_tasks)
+        # tasks are drained into the shard queues at commit; the persisted
+        # snapshot must not accumulate them across transactions
+        self.ms.transfer_tasks, self.ms.timer_tasks = [], []
+        # fenced conditional update FIRST: if this owner was deposed or the
+        # state moved underneath us, nothing is persisted — appending history
+        # first would orphan events in the strictly-contiguous branch and
+        # wedge the workflow (the reference's range-ID fence rejects at the
+        # same point, shard/context.go:586-700)
+        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
         self.engine.stores.history.append_batch(
             info.domain_id, info.workflow_id, info.run_id, self.events)
-        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
         self.engine.shard.insert_tasks(
             info.domain_id, info.workflow_id, info.run_id,
-            self.ms.transfer_tasks[n_transfer:], self.ms.timer_tasks[n_timer:])
+            new_transfer, new_timer)
         for fn in self._post:
             fn()

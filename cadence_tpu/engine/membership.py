@@ -78,6 +78,11 @@ class HashRing:
         with self._lock:
             self._listeners.append(listener)
 
+    def unsubscribe(self, listener: Callable[[], None]) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
     def _notify(self) -> None:
         for fn in list(self._listeners):
             fn()

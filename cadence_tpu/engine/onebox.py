@@ -67,11 +67,15 @@ class Onebox:
 
     def remove_host(self, name: str) -> None:
         """Host death: ring change → survivors steal its shards (the ringpop
-        failure-detection → acquireShards path)."""
+        failure-detection → acquireShards path). The dead controller is
+        unsubscribed FIRST: a dead host does not react to ring changes, and
+        leaving the listener would both leak it and gracefully release its
+        shards, masking the fencing path this simulates."""
         controller = self.controllers.pop(name)
         self.hosts.remove(name)
         self.processors = [p for p in self.processors
                            if p.controller is not controller]
+        self.ring.unsubscribe(controller._on_membership_change)
         self.ring.remove_member(name)
 
     # -- pumping -----------------------------------------------------------

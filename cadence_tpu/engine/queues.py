@@ -151,6 +151,9 @@ class QueueProcessors:
         ci = ms.pending_child_execution_info_ids.get(task.event_id)
         if ci is None:
             return  # already resolved
+        from ..core.enums import EMPTY_EVENT_ID
+        if ci.started_id != EMPTY_EVENT_ID:
+            return  # redelivered task; child already started (idempotency)
         parent_info = ms.execution_info
         child_engine = self.router(ci.started_workflow_id)
         child_run_id = child_engine.start_workflow(

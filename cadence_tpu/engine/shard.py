@@ -44,6 +44,22 @@ class ShardContext:
             self._max_task_id = (info.range_id + 1) * RANGE_SIZE
             self._closed = False
 
+    def _renew_range_locked(self) -> None:
+        """Fresh task-ID block for the CURRENT owner: the CAS is against our
+        cached range ID, so a deposed owner fails with ShardOwnershipLost
+        instead of silently re-stealing the shard (shard/context.go:1068)."""
+        info = ShardInfo(**vars(self._info))
+        expected = info.range_id
+        info.range_id += 1
+        try:
+            self._stores.shard.update(info, expected_range_id=expected)
+        except ShardOwnershipLostError:
+            self._closed = True
+            raise
+        self._info = info
+        self._next_task_id = info.range_id * RANGE_SIZE
+        self._max_task_id = (info.range_id + 1) * RANGE_SIZE
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -65,8 +81,7 @@ class ShardContext:
         with self._lock:
             self._ensure_open()
             if self._next_task_id >= self._max_task_id:
-                # renew range for a fresh block (renewRangeLocked on exhaustion)
-                self.acquire()
+                self._renew_range_locked()
             tid = self._next_task_id
             self._next_task_id += 1
             return tid

@@ -1,0 +1,73 @@
+"""Build + load the native packer (ctypes, g++, cached by source hash).
+
+No pip/pybind11 in this environment — the C ABI via ctypes is the binding
+layer. The shared object is rebuilt only when packer.cc changes; loading
+falls back to None (callers use the pure-Python packer) when no toolchain
+is available, so the framework stays importable everywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packer.cc")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_BUILD_DIR, f"libcadence_packer_{digest}.so")
+
+
+def build(verbose: bool = False) -> str:
+    """Compile packer.cc if needed; returns the .so path."""
+    so = _so_path()
+    if os.path.exists(so):
+        return so
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", so + ".tmp", _SRC,
+    ]
+    if verbose:
+        print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    os.replace(so + ".tmp", so)
+    return so
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if necessary); None when no toolchain is available."""
+    global _cached, _load_failed
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if _load_failed:
+            return None
+        try:
+            lib = ctypes.CDLL(build())
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+            _load_failed = True
+            return None
+        lib.cadence_pack_corpus.restype = ctypes.c_int64
+        lib.cadence_pack_corpus.argtypes = [
+            ctypes.c_char_p,                  # blob
+            ctypes.POINTER(ctypes.c_int64),   # offsets
+            ctypes.c_int64,                   # num_workflows
+            ctypes.c_int64,                   # max_events
+            ctypes.c_int64,                   # num_lanes
+            ctypes.POINTER(ctypes.c_int64),   # out
+            ctypes.c_int64,                   # num_threads
+        ]
+        _cached = lib
+        return lib

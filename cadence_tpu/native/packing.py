@@ -1,0 +1,62 @@
+"""High-level native packing API: serialized histories → lane tensors."""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.encode import NUM_LANES
+from . import build as _build
+
+
+def native_available() -> bool:
+    return _build.load() is not None
+
+
+def pack_serialized(blobs: Sequence[bytes], max_events: int,
+                    num_threads: Optional[int] = None,
+                    out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pack W serialized histories (core/codec.py wire bytes) into
+    [W, max_events, NUM_LANES] int64 with the native packer.
+
+    Pass a preallocated `out` to amortize page-fault cost in streaming
+    pipelines (the packer fully overwrites it — real rows and padding)."""
+    lib = _build.load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable (no C++ toolchain)")
+    if num_threads is None:
+        num_threads = min(len(blobs), os.cpu_count() or 1)
+    W = len(blobs)
+    blob = b"".join(blobs)
+    offsets = np.zeros(W + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    if out is None:
+        out = np.empty((W, max_events, NUM_LANES), dtype=np.int64)
+    else:
+        assert out.shape == (W, max_events, NUM_LANES) and out.dtype == np.int64
+    rc = lib.cadence_pack_corpus(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        W, max_events, NUM_LANES,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        num_threads,
+    )
+    if rc < 0:
+        workflow = (-rc) // 1000 - 1
+        err = (-rc) % 1000
+        raise ValueError(
+            f"native packer failed on workflow {workflow} (code {err}: "
+            f"1=truncated, 2=unknown attr, 3=history exceeds max_events)"
+        )
+    return out
+
+
+def encode_corpus_native(histories, max_events: int = 0) -> np.ndarray:
+    """Drop-in native replacement for ops.encode.encode_corpus."""
+    from ..core.codec import serialize_corpus
+
+    if max_events <= 0:
+        max_events = max(sum(len(b.events) for b in h) for h in histories)
+    return pack_serialized(serialize_corpus(histories), max_events)

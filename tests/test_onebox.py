@@ -204,3 +204,47 @@ class TestNorthStarLoop:
         assert result.ok, f"divergent workflows: {result.divergent}"
         assert result.verified_on_device == 8
         assert not result.fallback
+
+
+class TestContinueAsNew:
+    def test_continue_as_new_chains_recorded_run(self, box):
+        """The run recorded in the ContinuedAsNew event must exist and be
+        the current run (regression: a fresh uuid used to be minted)."""
+        from cadence_tpu.core.enums import DecisionType, EventType
+        from cadence_tpu.engine.history_engine import Decision
+
+        class CanOnceDecider:
+            def __init__(self):
+                self.generation = 0
+
+            def decide(self, history):
+                started = history[0]
+                if any(e.event_type == EventType.MarkerRecorded for e in history):
+                    return [Decision(DecisionType.CompleteWorkflowExecution)]
+                if started.get("marker_gen"):  # never set; first run continues
+                    return [Decision(DecisionType.CompleteWorkflowExecution)]
+                self.generation += 1
+                if self.generation == 1:
+                    return [Decision(DecisionType.ContinueAsNewWorkflowExecution,
+                                     dict(task_list=TL))]
+                return [Decision(DecisionType.CompleteWorkflowExecution)]
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-can", "can", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"wf-can": CanOnceDecider()})
+        poller.drain()
+        # first run closed as continued-as-new; its recorded new run exists
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        runs = [k for k in box.stores.execution.list_executions()
+                if k[1] == "wf-can"]
+        assert len(runs) == 2
+        first = next(
+            ms for k in runs
+            for ms in [box.stores.execution.get_workflow(*k)]
+            if ms.execution_info.close_status == CloseStatus.ContinuedAsNew)
+        history = box.stores.history.read_events(
+            domain_id, "wf-can", first.execution_info.run_id)
+        can_event = history[-1]
+        recorded_run = can_event.get("new_execution_run_id")
+        cur = box.stores.execution.get_current_run_id(domain_id, "wf-can")
+        assert recorded_run == cur
+        assert closed_status(box, "wf-can") == CloseStatus.Completed
