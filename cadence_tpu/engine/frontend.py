@@ -50,11 +50,18 @@ class Frontend:
     # -- domains (workflowHandler.go:265-437) ------------------------------
 
     def register_domain(self, name: str, retention_days: int = 1,
-                        is_active: bool = True) -> str:
-        domain_id = str(uuid.uuid4())
+                        is_active: bool = True,
+                        clusters: tuple = ("primary",),
+                        active_cluster: str = "primary",
+                        failover_version: int = 0,
+                        domain_id: str = "") -> str:
+        """Domain CRUD (workflowHandler.go:265). Global domains pass the same
+        domain_id on every cluster (the domain-replication invariant)."""
+        domain_id = domain_id or str(uuid.uuid4())
         self.stores.domain.register(DomainInfo(
             domain_id=domain_id, name=name, retention_days=retention_days,
-            is_active=is_active))
+            is_active=is_active, active_cluster=active_cluster,
+            clusters=tuple(clusters), failover_version=failover_version))
         return domain_id
 
     def describe_domain(self, name: str) -> DomainInfo:

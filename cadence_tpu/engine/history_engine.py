@@ -75,6 +75,23 @@ class HistoryEngine:
         self.shard = shard
         self.stores = stores
         self.clock = time_source
+        #: shared holder so a cluster can attach its replication publisher to
+        #: engines created before/after wiring ({"pub": ReplicationPublisher})
+        self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
+
+    def _publish_replication(self, domain_id: str, workflow_id: str,
+                             run_id: str, events) -> None:
+        """insertReplicationTasks analog: global domains stream every
+        committed batch to remote clusters."""
+        pub = self.replication_publisher_holder.get("pub")
+        if pub is None:
+            return
+        try:
+            if len(self.stores.domain.by_id(domain_id).clusters) < 2:
+                return
+        except EntityNotExistsError:
+            return
+        pub.publish(domain_id, workflow_id, run_id, events)
 
     # ------------------------------------------------------------------
     # transaction plumbing
@@ -97,6 +114,10 @@ class HistoryEngine:
         ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         # work on a copy so a failed transaction never corrupts the store
         ms = copy.deepcopy(ms)
+        # refresh the domain entry: StartTransaction re-reads the failover
+        # version so post-failover events carry the new version
+        # (mutable_state_builder.go:3941-3947)
+        ms.domain_entry = self._domain_entry(domain_id)
         return ms, ms.execution_info.next_event_id
 
     def _new_transaction(self, ms: MutableState) -> "_Txn":
@@ -119,6 +140,7 @@ class HistoryEngine:
                        run_id: Optional[str] = None) -> str:
         run_id = run_id or str(uuid.uuid4())
         ms = MutableState(self._domain_entry(domain_id))
+        version = ms.domain_entry.failover_version
         now = self.clock.now()
         start_attrs: Dict[str, Any] = dict(
             task_list=task_list, workflow_type=workflow_type,
@@ -137,12 +159,13 @@ class HistoryEngine:
 
         events = [
             HistoryEvent(id=1, event_type=EventType.WorkflowExecutionStarted,
-                         timestamp=now, attrs=start_attrs),
+                         version=version, timestamp=now, attrs=start_attrs),
         ]
         # generateFirstDecisionTask (historyEngine.go:529) unless delayed
         if first_decision_backoff <= 0:
             events.append(HistoryEvent(
-                id=2, event_type=EventType.DecisionTaskScheduled, timestamp=now,
+                id=2, event_type=EventType.DecisionTaskScheduled,
+                version=version, timestamp=now,
                 attrs=dict(task_list=task_list,
                            start_to_close_timeout_seconds=decision_timeout,
                            attempt=0),
@@ -158,6 +181,7 @@ class HistoryEngine:
         self.shard.insert_tasks(domain_id, workflow_id, run_id,
                                 ms.transfer_tasks, ms.timer_tasks)
         ms.transfer_tasks, ms.timer_tasks = [], []
+        self._publish_replication(domain_id, workflow_id, run_id, events)
         return run_id
 
     # ------------------------------------------------------------------
@@ -643,5 +667,7 @@ class _Txn:
         self.engine.shard.insert_tasks(
             info.domain_id, info.workflow_id, info.run_id,
             new_transfer, new_timer)
+        self.engine._publish_replication(info.domain_id, info.workflow_id,
+                                         info.run_id, self.events)
         for fn in self._post:
             fn()

@@ -1,0 +1,82 @@
+"""Two-cluster harness: active + standby with replication and failover.
+
+Reference analog: the XDC integration setup
+(config/development_xdc_cluster0/1.yaml cluster-group metadata +
+docker-compose-multiclusters) collapsed into one process — two Onebox
+clusters, the replication stream between them, and graceful failover
+(domain failover version bump; common/domain/failover_watcher.go and the
+failovermanager workflow drive the same transition in the reference).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.events import HistoryBatch
+from .cluster import ClusterMetadata
+from .onebox import Onebox
+from .replication import (
+    HistoryReplicator,
+    ReplicationPublisher,
+    ReplicationTaskProcessor,
+)
+
+
+class ReplicatedClusters:
+    def __init__(self, num_hosts: int = 1, num_shards: int = 4,
+                 metadata: Optional[ClusterMetadata] = None) -> None:
+        self.meta = metadata or ClusterMetadata()
+        self.active = Onebox(num_hosts=num_hosts, num_shards=num_shards,
+                             cluster_name="primary")
+        self.standby = Onebox(num_hosts=num_hosts, num_shards=num_shards,
+                              cluster_name="standby")
+        self.publisher = ReplicationPublisher(self.active.stores)
+        self.active.set_replication_publisher(self.publisher)
+        self.replicator = HistoryReplicator(self.standby.stores)
+        self.processor = ReplicationTaskProcessor(
+            self.replicator, self.publisher, self.standby.stores,
+            source_history_reader=self._read_source_history)
+
+    def _read_source_history(self, domain_id: str, workflow_id: str,
+                             run_id: str, from_event_id: int,
+                             to_event_id: int) -> List[HistoryBatch]:
+        """Admin GetWorkflowExecutionRawHistoryV2 analog for the resender."""
+        batches = self.active.stores.history.as_history_batches(
+            domain_id, workflow_id, run_id)
+        return [b for b in batches
+                if from_event_id <= b.events[0].id < to_event_id]
+
+    def register_global_domain(self, name: str, retention_days: int = 1) -> str:
+        version = self.meta.initial_failover_version("primary")
+        domain_id = self.active.frontend.register_domain(
+            name, retention_days=retention_days, is_active=True,
+            clusters=self.meta.cluster_names, active_cluster="primary",
+            failover_version=version)
+        self.standby.frontend.register_domain(
+            name, retention_days=retention_days, is_active=False,
+            clusters=self.meta.cluster_names, active_cluster="primary",
+            failover_version=version, domain_id=domain_id)
+        return domain_id
+
+    def replicate(self) -> int:
+        """Drain the replication stream into the standby."""
+        total = 0
+        while True:
+            n = self.processor.process_once()
+            total += n
+            if n == 0:
+                return total
+
+    def failover(self, domain_name: str, to_cluster: str = "standby") -> int:
+        """Graceful failover: bump the domain failover version into the
+        target cluster's slot on BOTH clusters (domain metadata replication
+        is synchronous here; the reference streams it via the worker
+        replicator). Returns the new failover version."""
+        current = self.active.stores.domain.by_name(domain_name).failover_version
+        new_version = self.meta.next_failover_version(to_cluster, current)
+        for box in (self.active, self.standby):
+            d = box.stores.domain.by_name(domain_name)
+            d.failover_version = new_version
+            d.active_cluster = to_cluster
+            d.is_active = box.cluster_name == to_cluster
+            box.stores.domain.update(d)
+        return new_version

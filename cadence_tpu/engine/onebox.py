@@ -25,13 +25,19 @@ NANOS = 1_000_000_000
 
 
 class Onebox:
-    def __init__(self, num_hosts: int = 2, num_shards: int = 8) -> None:
+    def __init__(self, num_hosts: int = 2, num_shards: int = 8,
+                 cluster_name: str = "primary") -> None:
         self.stores = Stores()
         self.clock = ManualTimeSource()
+        self.cluster_name = cluster_name
+        self.num_shards = num_shards
+        #: shared across every engine this cluster creates
+        self._publisher_holder = {"pub": None}
         self.hosts = [f"host-{i}" for i in range(num_hosts)]
         self.ring = HashRing(self.hosts)
         self.controllers: Dict[str, ShardController] = {
-            h: ShardController(h, num_shards, self.stores, self.ring, self.clock)
+            h: ShardController(h, num_shards, self.stores, self.ring, self.clock,
+                               engine_factory=self._make_engine)
             for h in self.hosts
         }
         self.matching = MatchingEngine(self.stores)
@@ -42,6 +48,15 @@ class Onebox:
         ]
         self.frontend = Frontend(self.stores, self.matching, self.route)
         self.tpu = TPUReplayEngine(self.stores)
+
+    def _make_engine(self, shard) -> HistoryEngine:
+        engine = HistoryEngine(shard, self.stores, self.clock)
+        engine.replication_publisher_holder = self._publisher_holder
+        return engine
+
+    def set_replication_publisher(self, publisher) -> None:
+        """Attach the cross-cluster stream (covers engines past and future)."""
+        self._publisher_holder["pub"] = publisher
 
     # -- routing (client/history peer resolver analog) ---------------------
 
@@ -56,8 +71,9 @@ class Onebox:
     # -- cluster dynamics --------------------------------------------------
 
     def add_host(self, name: str) -> None:
-        controller = ShardController(name, self.controllers[self.hosts[0]].num_shards,
-                                     self.stores, self.ring, self.clock)
+        controller = ShardController(name, self.num_shards,
+                                     self.stores, self.ring, self.clock,
+                                     engine_factory=self._make_engine)
         self.controllers[name] = controller
         self.hosts.append(name)
         self.processors.append(QueueProcessors(controller, self.matching,

@@ -1,0 +1,242 @@
+"""Cross-cluster (NDC/XDC) history replication.
+
+Reference call stack (SURVEY.md §3.5):
+- source: replication tasks inserted at transaction close
+  (mutable_state_builder.go:3959 insertReplicationTasks), hydrated by
+  TaskAckManager.GetTasks (replication/task_ack_manager.go:145);
+- target: TaskFetcher polls per source cluster → taskExecutor →
+  historyReplicator.ApplyEvents (ndc/history_replicator.go:183) →
+  stateBuilder.ApplyEvents (the replay hot loop);
+- gaps: the passive side pulls the missing range via the history resender
+  (common/ndc/history_resender.go:111);
+- poison tasks land in the replication DLQ (replication/dlq_handler.go).
+
+Here the replication transport payload is the framework's binary codec
+(core/codec.py) — the same bytes the native packer consumes — so the
+passive side can either apply per-workflow through the oracle state
+builder (incremental, this module) or bulk-verify/rehydrate thousands of
+workflows at once on the TPU (tpu_engine.py), which is BASELINE config 5's
+"resend-buffered-history replay" path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.codec import deserialize_history, serialize_history
+from ..core.events import HistoryBatch, HistoryEvent
+from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
+from ..oracle.state_builder import StateBuilder
+from .persistence import EntityNotExistsError, Stores
+
+REPLICATION_QUEUE = "replication"
+REPLICATION_DLQ = "replication-dlq"
+
+
+@dataclass
+class ReplicationTask:
+    """One history batch crossing the cluster boundary
+    (types.ReplicationTask/HistoryTaskV2Attributes analog)."""
+
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    first_event_id: int
+    next_event_id: int
+    version: int
+    events_blob: bytes  # codec-serialized single batch
+
+
+class RetryReplicationError(Exception):
+    """Gap detected: events [from_event_id, to_event_id) must be resent
+    first (types.RetryTaskV2Error analog)."""
+
+    def __init__(self, from_event_id: int, to_event_id: int) -> None:
+        super().__init__(f"missing events [{from_event_id}, {to_event_id})")
+        self.from_event_id = from_event_id
+        self.to_event_id = to_event_id
+
+
+class ReplicationPublisher:
+    """Source side: capture committed batches into the replication queue
+    (the insertReplicationTasks seat)."""
+
+    def __init__(self, stores: Stores) -> None:
+        self.stores = stores
+
+    def publish(self, domain_id: str, workflow_id: str, run_id: str,
+                events: List[HistoryEvent]) -> None:
+        batch = HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
+                             run_id=run_id, events=events)
+        task = ReplicationTask(
+            domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
+            first_event_id=events[0].id, next_event_id=events[-1].id + 1,
+            version=events[-1].version,
+            events_blob=serialize_history([batch]),
+        )
+        self.stores.queue.enqueue(REPLICATION_QUEUE, task)
+
+    def read_tasks(self, from_index: int, count: int = 100
+                   ) -> List[Tuple[int, ReplicationTask]]:
+        """GetReplicationMessages analog (remote pollers track their index)."""
+        return self.stores.queue.read(REPLICATION_QUEUE, from_index, count)
+
+
+class HistoryReplicator:
+    """Target side: apply replicated batches to the standby cluster's state.
+
+    Implements the linear-lineage NDC subset: contiguity via next-event-id,
+    stale-task dedup, version monotonicity via version histories (enforced
+    by the state builder), gap → RetryReplicationError for the resender.
+    Divergent-branch conflict resolution (branch forks) is the documented
+    round-2 extension (ndc/branch_manager.go)."""
+
+    def __init__(self, stores: Stores) -> None:
+        self.stores = stores
+        #: in-flight mutable states (the execution cache analog); flushed
+        #: through the standby stores on every apply
+        self._cache: Dict[Tuple[str, str, str], MutableState] = {}
+
+    def _load(self, task: ReplicationTask) -> Optional[MutableState]:
+        key = (task.domain_id, task.workflow_id, task.run_id)
+        ms = self._cache.get(key)
+        if ms is not None:
+            return ms
+        try:
+            ms = self.stores.execution.get_workflow(*key)
+            self._cache[key] = ms
+            return ms
+        except EntityNotExistsError:
+            return None
+
+    def apply(self, task: ReplicationTask) -> bool:
+        """Apply one task. Returns False when the task is stale (dedup);
+        raises RetryReplicationError on gaps, ReplayError on corrupt input."""
+        batches = deserialize_history(task.events_blob, task.domain_id,
+                                      task.workflow_id, task.run_id)
+        ms = self._load(task)
+        if ms is None:
+            if task.first_event_id != 1:
+                # first batch missing: pull history from the start
+                raise RetryReplicationError(1, task.first_event_id)
+            domain = self._domain_entry(task.domain_id)
+            ms = MutableState(domain)
+        next_id = ms.execution_info.next_event_id
+        if task.first_event_id < next_id:
+            return False  # already applied (dedup / at-least-once delivery)
+        if task.first_event_id > next_id:
+            raise RetryReplicationError(next_id, task.first_event_id)
+
+        sb = StateBuilder(ms)
+        for batch in batches:
+            sb.apply_batch(batch)
+        key = (task.domain_id, task.workflow_id, task.run_id)
+        self._cache[key] = ms
+        self._persist(ms, batches)
+        return True
+
+    def _domain_entry(self, domain_id: str) -> DomainEntry:
+        try:
+            d = self.stores.domain.by_id(domain_id)
+            return DomainEntry(domain_id=d.domain_id, name=d.name,
+                               is_active=False,  # passive side
+                               retention_days=d.retention_days)
+        except EntityNotExistsError:
+            return DomainEntry(domain_id=domain_id, is_active=False)
+
+    def _persist(self, ms: MutableState, batches: List[HistoryBatch]) -> None:
+        """UpdateWorkflowExecutionAsPassive analog: append history + upsert
+        the snapshot (no active-side conditional needed — the replicator is
+        the only writer on the standby)."""
+        info = ms.execution_info
+        for batch in batches:
+            self.stores.history.append_batch(info.domain_id, info.workflow_id,
+                                             info.run_id, batch.events)
+        store = self.stores.execution
+        with store._lock:  # passive upsert, single writer
+            key = (info.domain_id, info.workflow_id, info.run_id)
+            store._executions[key] = ms
+            from .persistence import CurrentExecution
+            store._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
+                run_id=info.run_id, state=info.state,
+                close_status=info.close_status)
+
+
+@dataclass
+class DLQEntry:
+    task: ReplicationTask
+    error: str
+
+
+class ReplicationTaskProcessor:
+    """Target-side pump: polls the source queue, applies tasks, resolves
+    gaps via the resender, quarantines poison tasks in the DLQ
+    (replication/task_processor.go + task_fetcher.go)."""
+
+    def __init__(self, replicator: HistoryReplicator, source: ReplicationPublisher,
+                 target_stores: Stores,
+                 source_history_reader: Optional[Callable] = None) -> None:
+        self.replicator = replicator
+        self.source = source
+        self.stores = target_stores
+        #: SendSingleWorkflowHistory analog: (domain, wf, run, from_id, to_id)
+        #: → batches from the source cluster's history store
+        self.source_history_reader = source_history_reader
+        self.ack_index = 0
+        self.applied = 0
+        self.deduped = 0
+        self.resends = 0
+
+    def process_once(self, batch_size: int = 100) -> int:
+        tasks = self.source.read_tasks(self.ack_index, batch_size)
+        for index, task in tasks:
+            try:
+                if self.replicator.apply(task):
+                    self.applied += 1
+                else:
+                    self.deduped += 1
+            except RetryReplicationError as gap:
+                self._resend(task, gap)
+            except ReplayError as err:
+                self.stores.queue.enqueue(REPLICATION_DLQ,
+                                          DLQEntry(task=task, error=str(err)))
+            self.ack_index = index + 1
+        return len(tasks)
+
+    def _resend(self, task: ReplicationTask, gap: RetryReplicationError) -> None:
+        """Pull the missing range and re-apply (history_resender.go:111)."""
+        if self.source_history_reader is None:
+            self.stores.queue.enqueue(
+                REPLICATION_DLQ, DLQEntry(task=task, error=str(gap)))
+            return
+        self.resends += 1
+        missing = self.source_history_reader(
+            task.domain_id, task.workflow_id, task.run_id,
+            gap.from_event_id, gap.to_event_id)
+        for batch in missing:
+            self.replicator.apply(ReplicationTask(
+                domain_id=task.domain_id, workflow_id=task.workflow_id,
+                run_id=task.run_id, first_event_id=batch.events[0].id,
+                next_event_id=batch.events[-1].id + 1,
+                version=batch.events[-1].version,
+                events_blob=serialize_history([batch]),
+            ))
+        self.replicator.apply(task)
+        self.applied += 1
+
+    # -- DLQ surface (replication/dlq_handler.go read/purge/merge) ---------
+
+    def read_dlq(self) -> List[DLQEntry]:
+        return [e for _, e in self.stores.queue.read(REPLICATION_DLQ, 0, 10_000)]
+
+    def merge_dlq(self) -> int:
+        """Retry everything in the DLQ; returns how many now applied."""
+        entries = self.read_dlq()
+        ok = 0
+        for entry in entries:
+            try:
+                if self.replicator.apply(entry.task):
+                    ok += 1
+            except (RetryReplicationError, ReplayError):
+                pass
+        return ok

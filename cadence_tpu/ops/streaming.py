@@ -1,0 +1,81 @@
+"""Chunked event streaming: replay histories longer than device memory.
+
+The sequence axis of this framework is history length (SURVEY.md §2.6 P6):
+replay is inherently sequential per workflow, so the long-context strategy
+is not ring attention but event-axis chunking with carried state — the scan
+runs chunk by chunk while the host packs and ships the next chunk
+(double-buffering, the reference's queue-pipeline analog P7).
+
+The carried ReplayState is donated to each chunk step, so device memory
+holds one state + at most two event chunks regardless of total history
+length; jax's async dispatch overlaps the host-side packing of chunk N+1
+with device replay of chunk N.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
+from .payload import payload_rows
+from .state import ReplayState, init_state
+from .transitions import step
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _replay_chunk(s: ReplayState, events: jnp.ndarray) -> ReplayState:
+    """Apply one [W, E_chunk, L] chunk to carried state (donated in-place)."""
+    def body(carry, ev):
+        return step(carry, ev), None
+
+    s, _ = jax.lax.scan(body, s, jnp.swapaxes(events, 0, 1))
+    return s
+
+
+class StreamingReplayer:
+    """Feed event chunks for W workflows; state carries across chunks.
+
+    Chunks must split histories only at event boundaries (any boundary is
+    legal: batch bookkeeping lanes travel with each event). Padding rows
+    (event id 0) are no-ops, so ragged chunking across workflows is fine.
+    """
+
+    def __init__(self, num_workflows: int,
+                 layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
+        self.layout = layout
+        self.num_workflows = num_workflows
+        self.state: ReplayState = init_state(num_workflows, layout)
+        self._pending: Optional[jax.Array] = None
+
+    def feed(self, chunk: np.ndarray) -> None:
+        """Ship a [W, E_chunk, L] chunk; dispatch is async, so the caller can
+        immediately start packing the next chunk."""
+        assert chunk.shape[0] == self.num_workflows
+        device_chunk = jax.device_put(chunk)
+        self.state = _replay_chunk(self.state, device_chunk)
+
+    def finish(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (payload rows, errors) after all fed chunks."""
+        rows = payload_rows(self.state, self.layout)
+        return np.asarray(rows), np.asarray(self.state.error)
+
+
+def replay_streamed(events: np.ndarray, chunk_events: int,
+                    layout: PayloadLayout = DEFAULT_LAYOUT
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Convenience: replay a full [W, E, L] tensor in chunks of chunk_events."""
+    replayer = StreamingReplayer(events.shape[0], layout)
+    for start in range(0, events.shape[1], chunk_events):
+        chunk = events[:, start:start + chunk_events]
+        if chunk.shape[1] < chunk_events:
+            # pad the tail chunk to the steady shape: one compiled executable
+            pad = np.zeros((chunk.shape[0], chunk_events - chunk.shape[1],
+                            chunk.shape[2]), dtype=chunk.dtype)
+            pad[:, :, 1] = -1  # LANE_EVENT_TYPE padding marker
+            chunk = np.concatenate([chunk, pad], axis=1)
+        replayer.feed(chunk)
+    return replayer.finish()

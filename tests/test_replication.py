@@ -1,0 +1,170 @@
+"""Cross-cluster replication + streaming replay tests: the NDC tier
+(host/ndc/integration_test.go analog) plus the long-context chunked path."""
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import payload_row
+from cadence_tpu.core.enums import CloseStatus, WorkflowState
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from cadence_tpu.models.deciders import EchoDecider, SignalDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "global-domain"
+TL = "xdc-tasklist"
+
+
+@pytest.fixture()
+def clusters():
+    c = ReplicatedClusters(num_hosts=1, num_shards=4)
+    c.register_global_domain(DOMAIN)
+    return c
+
+
+def run_echo(clusters, workflow_id):
+    box = clusters.active
+    box.frontend.start_workflow_execution(DOMAIN, workflow_id, "echo", TL)
+    poller = TaskPoller(box, DOMAIN, TL, {workflow_id: EchoDecider(TL)})
+    poller.drain()
+    return poller
+
+
+class TestReplication:
+    def test_standby_state_matches_active(self, clusters):
+        run_echo(clusters, "xdc-1")
+        applied = clusters.replicate()
+        assert applied > 0
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "xdc-1")
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "xdc-1", run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "xdc-1", run_id)
+        assert standby_ms.execution_info.close_status == CloseStatus.Completed
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+        # histories byte-equal event-for-event
+        a = clusters.active.stores.history.read_events(domain_id, "xdc-1", run_id)
+        s = clusters.standby.stores.history.read_events(domain_id, "xdc-1", run_id)
+        assert [(e.id, e.event_type, e.version) for e in a] == \
+               [(e.id, e.event_type, e.version) for e in s]
+
+    def test_events_carry_active_failover_version(self, clusters):
+        run_echo(clusters, "xdc-v")
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "xdc-v")
+        events = clusters.active.stores.history.read_events(
+            domain_id, "xdc-v", run_id)
+        assert all(e.version == 1 for e in events)  # primary initial version
+
+    def test_gap_triggers_resend(self, clusters):
+        """Drop mid-stream tasks: the resender must pull the missing range
+        (history_resender.go:111 path)."""
+        run_echo(clusters, "xdc-gap")
+        # skip the first 3 replication tasks → guaranteed gap
+        clusters.processor.ack_index = 3
+        clusters.replicate()
+        assert clusters.processor.resends >= 1
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "xdc-gap")
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "xdc-gap", run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "xdc-gap", run_id)
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+
+    def test_duplicate_delivery_deduped(self, clusters):
+        run_echo(clusters, "xdc-dup")
+        clusters.replicate()
+        # replay the whole stream again (at-least-once delivery)
+        clusters.processor.ack_index = 0
+        clusters.replicate()
+        assert clusters.processor.deduped > 0
+
+    def test_standby_bulk_verified_on_device(self, clusters):
+        """BASELINE config 5: the standby's replicated histories replay on
+        device with zero divergence (the kernel as the NDC bulk-apply)."""
+        for i in range(4):
+            run_echo(clusters, f"xdc-bulk-{i}")
+        clusters.replicate()
+        result = clusters.standby.tpu.verify_all()
+        assert result.total == 4
+        assert result.ok and result.verified_on_device == 4
+
+    def test_corrupt_task_goes_to_dlq(self, clusters):
+        from cadence_tpu.engine.replication import ReplicationTask
+        from cadence_tpu.core.codec import serialize_history
+        from cadence_tpu.core.events import HistoryBatch, HistoryEvent
+        from cadence_tpu.core.enums import EventType
+
+        run_echo(clusters, "xdc-dlq")
+        clusters.replicate()
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "xdc-dlq")
+        # craft a poison batch: contiguity holds but semantics are invalid
+        ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "xdc-dlq", run_id)
+        next_id = ms.execution_info.next_event_id
+        bad = HistoryBatch(domain_id=domain_id, workflow_id="xdc-dlq",
+                           run_id=run_id, events=[
+            HistoryEvent(id=next_id, event_type=EventType.ActivityTaskCompleted,
+                         version=1, timestamp=1,
+                         attrs=dict(scheduled_event_id=9999,
+                                    started_event_id=9998)),
+        ])
+        clusters.publisher.stores.queue.enqueue(
+            "replication",
+            ReplicationTask(domain_id=domain_id, workflow_id="xdc-dlq",
+                            run_id=run_id, first_event_id=next_id,
+                            next_event_id=next_id + 1, version=1,
+                            events_blob=serialize_history([bad])))
+        clusters.replicate()
+        dlq = clusters.processor.read_dlq()
+        assert len(dlq) == 1
+        assert "missing activity" in dlq[0].error
+
+
+class TestFailover:
+    def test_failover_continues_workflow_on_standby(self, clusters):
+        """Active runs half the workflow; failover; the standby (now active)
+        finishes it; event versions cross the failover boundary and the
+        version history records both items."""
+        box = clusters.active
+        box.frontend.start_workflow_execution(DOMAIN, "xdc-fo", "signal", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"xdc-fo": SignalDecider(expected_signals=1)})
+        poller.drain()
+        clusters.replicate()
+
+        new_version = clusters.failover(DOMAIN, "standby")
+        assert new_version == 12  # standby initial 2 + increment 10
+
+        sbox = clusters.standby
+        spoller = TaskPoller(sbox, DOMAIN, TL,
+                             {"xdc-fo": SignalDecider(expected_signals=1)})
+        sbox.frontend.signal_workflow_execution(DOMAIN, "xdc-fo", "wake")
+        spoller.drain()
+        domain_id = sbox.stores.domain.by_name(DOMAIN).domain_id
+        ms = sbox.frontend.describe_workflow_execution(DOMAIN, "xdc-fo")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        items = ms.version_histories.current().items
+        assert [i.version for i in items] == [1, 12]
+
+
+class TestStreamingReplay:
+    def test_chunked_matches_single_shot(self):
+        from cadence_tpu.gen.corpus import generate_corpus
+        from cadence_tpu.ops.encode import encode_corpus
+        from cadence_tpu.ops.replay import replay_to_payload
+        from cadence_tpu.ops.streaming import replay_streamed
+        import jax.numpy as jnp
+
+        histories = generate_corpus("basic", 8, seed=17, target_events=120)
+        events = encode_corpus(histories)
+        single, errs1 = replay_to_payload(jnp.asarray(events))
+        for chunk in (16, 33, 120, 500):
+            rows, errs = replay_streamed(events, chunk_events=chunk)
+            assert (errs == 0).all()
+            assert (rows == np.asarray(single)).all(), f"chunk={chunk} diverged"
