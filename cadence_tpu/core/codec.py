@@ -62,9 +62,32 @@ A_RETRY_INIT_INTERVAL = 25
 A_RETRY_COEFF_MILLI = 26    # backoff coefficient * 1000, integer
 A_RETRY_MAX_INTERVAL = 27
 A_RETRY_MAX_ATTEMPTS = 28
+# routing/lineage strings (round 2): a standby rebuilt from replicated blobs
+# must be able to DRIVE the workflow after failover — dispatch decisions and
+# activities to the real task list, start children, deliver external
+# signals/cancels, follow continue-as-new chains. The reference replicates
+# full thrift event blobs so these always survive the wire
+# (common/persistence/serialization/serializer.go); here they are explicit
+# codes. Keep native/packer.cc in lockstep (it refuses unknown codes).
+A_TASK_LIST = 29            # string
+A_WORKFLOW_TYPE = 30        # string
+A_CRON_SCHEDULE = 31        # string
+A_FIRST_EXEC_RUN_ID = 32    # string
+A_REQUEST_ID = 33           # string
+A_TARGET_WORKFLOW_ID = 34   # string ("workflow_id" on initiated/started events)
+A_TARGET_RUN_ID = 35        # string ("run_id")
+A_TARGET_DOMAIN_ID = 36     # string ("domain_id")
+A_SIGNAL_NAME = 37          # string
+A_NEW_RUN_ID = 38           # string ("new_execution_run_id", ContinuedAsNew)
+A_PARENT_CLOSE_POLICY = 39
+A_CHILD_WF_ONLY = 40        # "child_workflow_only" on external cancel/signal
 
 STRING_CODES = frozenset({A_ACTIVITY_ID, A_TIMER_ID, A_PARENT_WORKFLOW_ID,
-                          A_PARENT_RUN_ID, A_PARENT_DOMAIN_ID})
+                          A_PARENT_RUN_ID, A_PARENT_DOMAIN_ID,
+                          A_TASK_LIST, A_WORKFLOW_TYPE, A_CRON_SCHEDULE,
+                          A_FIRST_EXEC_RUN_ID, A_REQUEST_ID,
+                          A_TARGET_WORKFLOW_ID, A_TARGET_RUN_ID,
+                          A_TARGET_DOMAIN_ID, A_SIGNAL_NAME, A_NEW_RUN_ID})
 
 _EV_HEAD = struct.Struct("<qBqqqB")  # id, type, version, ts, task_id, n_attrs
 _I64 = struct.Struct("<q")
@@ -96,12 +119,21 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
         if retry.expiration_interval_seconds:
             out.append((A_RETRY_EXPIRATION, retry.expiration_interval_seconds))
 
+    def string(code: int, key: str) -> None:
+        v = g(key, "")
+        if v:
+            out.append((code, v))
+
     if et == EventType.WorkflowExecutionStarted:
         num(A_EXEC_TIMEOUT, "execution_start_to_close_timeout_seconds")
         num(A_TASK_TIMEOUT, "task_start_to_close_timeout_seconds")
         num(A_BACKOFF, "first_decision_task_backoff_seconds")
         num(A_ATTEMPT, "attempt")
         num(A_EXPIRATION_TS, "expiration_timestamp")
+        string(A_TASK_LIST, "task_list")
+        string(A_WORKFLOW_TYPE, "workflow_type")
+        string(A_CRON_SCHEDULE, "cron_schedule")
+        string(A_FIRST_EXEC_RUN_ID, "first_execution_run_id")
         if g("parent_workflow_id"):
             out.append((A_PARENT_WORKFLOW_ID, g("parent_workflow_id")))
             out.append((A_PARENT_RUN_ID, g("parent_run_id", "")))
@@ -115,8 +147,10 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
     elif et == EventType.DecisionTaskScheduled:
         num(A_STC, "start_to_close_timeout_seconds")
         num(A_ATTEMPT, "attempt")
+        string(A_TASK_LIST, "task_list")
     elif et in (EventType.DecisionTaskStarted, EventType.ActivityTaskStarted):
         num(A_SCHED_EVENT_ID, "scheduled_event_id")
+        string(A_REQUEST_ID, "request_id")
     elif et == EventType.DecisionTaskCompleted:
         num(A_SCHED_EVENT_ID, "scheduled_event_id")
         num(A_STARTED_EVENT_ID, "started_event_id")
@@ -128,6 +162,8 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
         num(A_S2C, "schedule_to_close_timeout_seconds")
         num(A_STC, "start_to_close_timeout_seconds")
         num(A_HEARTBEAT, "heartbeat_timeout_seconds")
+        string(A_TASK_LIST, "task_list")
+        string(A_TARGET_DOMAIN_ID, "domain_id")
         retry: RetryPolicy = g("retry_policy")
         if retry is not None:
             retry_fields(retry)
@@ -141,8 +177,26 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
         num(A_START_TO_FIRE, "start_to_fire_timeout_seconds")
     elif et in (EventType.TimerFired, EventType.TimerCanceled):
         out.append((A_TIMER_ID, g("timer_id", "")))
+    elif et == EventType.StartChildWorkflowExecutionInitiated:
+        string(A_TARGET_WORKFLOW_ID, "workflow_id")
+        string(A_TARGET_DOMAIN_ID, "domain_id")
+        string(A_WORKFLOW_TYPE, "workflow_type")
+        string(A_TASK_LIST, "task_list")
+        num(A_PARENT_CLOSE_POLICY, "parent_close_policy")
+    elif et in (EventType.SignalExternalWorkflowExecutionInitiated,
+                EventType.RequestCancelExternalWorkflowExecutionInitiated):
+        string(A_TARGET_WORKFLOW_ID, "workflow_id")
+        string(A_TARGET_RUN_ID, "run_id")
+        string(A_TARGET_DOMAIN_ID, "domain_id")
+        num(A_CHILD_WF_ONLY, "child_workflow_only")
+        if et == EventType.SignalExternalWorkflowExecutionInitiated:
+            string(A_SIGNAL_NAME, "signal_name")
+    elif et == EventType.WorkflowExecutionContinuedAsNew:
+        string(A_NEW_RUN_ID, "new_execution_run_id")
+    elif et == EventType.ChildWorkflowExecutionStarted:
+        num(A_INITIATED_EVENT_ID, "initiated_event_id")
+        string(A_TARGET_RUN_ID, "run_id")
     elif et in (
-        EventType.ChildWorkflowExecutionStarted,
         EventType.StartChildWorkflowExecutionFailed,
         EventType.ChildWorkflowExecutionCompleted,
         EventType.ChildWorkflowExecutionFailed,
@@ -257,4 +311,16 @@ _CODE_TO_KEY = {
     A_RETRY_COEFF_MILLI: "retry_coeff_milli",
     A_RETRY_MAX_INTERVAL: "retry_maximum_interval",
     A_RETRY_MAX_ATTEMPTS: "retry_maximum_attempts",
+    A_TASK_LIST: "task_list",
+    A_WORKFLOW_TYPE: "workflow_type",
+    A_CRON_SCHEDULE: "cron_schedule",
+    A_FIRST_EXEC_RUN_ID: "first_execution_run_id",
+    A_REQUEST_ID: "request_id",
+    A_TARGET_WORKFLOW_ID: "workflow_id",
+    A_TARGET_RUN_ID: "run_id",
+    A_TARGET_DOMAIN_ID: "domain_id",
+    A_SIGNAL_NAME: "signal_name",
+    A_NEW_RUN_ID: "new_execution_run_id",
+    A_PARENT_CLOSE_POLICY: "parent_close_policy",
+    A_CHILD_WF_ONLY: "child_workflow_only",
 }

@@ -577,6 +577,30 @@ class HistoryEngine:
         txn.commit(expected)
 
     # ------------------------------------------------------------------
+    # Task refresh (mutable_state_task_refresher.go:77 RefreshTasks)
+    # ------------------------------------------------------------------
+
+    def refresh_tasks(self, domain_id: str, workflow_id: str,
+                      run_id: Optional[str] = None) -> int:
+        """Regenerate all outstanding tasks from mutable state and insert
+        them into this shard's queues. Called on standby promotion (the
+        workflow changed hands and its task rows live on the old active
+        cluster) and by admin refresh. Returns the number of tasks created."""
+        from .task_refresher import refresh_tasks as _refresh
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        run_id = ms.execution_info.run_id
+        events = self.stores.history.read_events(domain_id, workflow_id, run_id)
+        ms.transfer_tasks, ms.timer_tasks = [], []
+        _refresh(ms, {e.id: e for e in events})
+        transfer, timer = list(ms.transfer_tasks), list(ms.timer_tasks)
+        ms.transfer_tasks, ms.timer_tasks = [], []
+        # persist the refreshed timer-created bits so later transactions
+        # don't double-create activity/user timer tasks
+        self.shard.update_workflow(ms, expected)
+        self.shard.insert_tasks(domain_id, workflow_id, run_id, transfer, timer)
+        return len(transfer) + len(timer)
+
+    # ------------------------------------------------------------------
     # reads
     # ------------------------------------------------------------------
 

@@ -79,4 +79,15 @@ class ReplicatedClusters:
             d.active_cluster = to_cluster
             d.is_active = box.cluster_name == to_cluster
             box.stores.domain.update(d)
+        # Standby promotion: the replicated state carries no tasks
+        # (replication.py discards them), so every open workflow on the
+        # newly-active cluster regenerates its outstanding tasks from
+        # mutable state (RefreshTasks, mutable_state_task_refresher.go:77) —
+        # without this, pre-failover pending work (in-flight activities,
+        # user timers, pending decisions) never runs on the new active side.
+        promoted = self.standby if to_cluster == "standby" else self.active
+        domain_id = promoted.stores.domain.by_name(domain_name).domain_id
+        for d_id, wf_id, run_id in \
+                promoted.stores.execution.list_domain_executions(domain_id):
+            promoted.route(wf_id).refresh_tasks(d_id, wf_id, run_id)
         return new_version

@@ -214,6 +214,19 @@ class ExecutionStore:
                     close_status=info.close_status,
                 )
 
+    def upsert_workflow(self, ms: MutableState) -> None:
+        """UpdateWorkflowExecutionAsPassive analog: unconditional snapshot
+        upsert + current-run pointer, used by the standby-side replicator
+        (the replicator is the single writer on a passive cluster, so no
+        range-ID fence or next-event-id condition applies)."""
+        info = ms.execution_info
+        with self._lock:
+            self._executions[(info.domain_id, info.workflow_id, info.run_id)] = ms
+            self._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
+                run_id=info.run_id, state=info.state,
+                close_status=info.close_status,
+            )
+
     def get_workflow(self, domain_id: str, workflow_id: str, run_id: str
                      ) -> MutableState:
         with self._lock:
@@ -232,6 +245,13 @@ class ExecutionStore:
     def list_executions(self) -> List[Tuple[str, str, str]]:
         with self._lock:
             return list(self._executions.keys())
+
+    def list_domain_executions(self, domain_id: str) -> List[Tuple[str, str, str]]:
+        """All runs of one domain — the task-refresh sweep on failover
+        promotion iterates these (completed runs too: their close fan-out /
+        retention timer may not have run on this cluster yet)."""
+        with self._lock:
+            return [key for key in self._executions if key[0] == domain_id]
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ from ..core.enums import (
 )
 from ..oracle.mutable_state import GeneratedTask
 from ..utils.clock import TimeSource
+from .history_engine import InvalidRequestError
 from .matching import MatchingEngine
 from .persistence import EntityNotExistsError, Stores
 
@@ -213,8 +214,10 @@ class QueueProcessors:
             target.request_cancel_workflow(task.target_domain_id or domain_id,
                                            task.target_workflow_id,
                                            run_id=task.target_run_id or None)
-        except Exception:
+        except EntityNotExistsError:
             failed = True
+        except InvalidRequestError:
+            pass  # cancellation already requested on the target: delivered
         engine.on_external_cancel_delivered(domain_id, workflow_id, run_id,
                                             task.event_id, failed=failed)
 

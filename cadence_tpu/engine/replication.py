@@ -20,6 +20,7 @@ workflows at once on the TPU (tpu_engine.py), which is BASELINE config 5's
 """
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -111,9 +112,15 @@ class HistoryReplicator:
 
     def apply(self, task: ReplicationTask) -> bool:
         """Apply one task. Returns False when the task is stale (dedup);
-        raises RetryReplicationError on gaps, ReplayError on corrupt input."""
+        raises RetryReplicationError on gaps, ReplayError on corrupt input.
+
+        The batch is applied to a SCRATCH COPY of the loaded state: a
+        poison batch that fails mid-apply must leave neither the cache nor
+        the store holding partially-applied state (the reference's workflow
+        context clears cached mutable state on apply failure)."""
         batches = deserialize_history(task.events_blob, task.domain_id,
                                       task.workflow_id, task.run_id)
+        key = (task.domain_id, task.workflow_id, task.run_id)
         ms = self._load(task)
         if ms is None:
             if task.first_event_id != 1:
@@ -121,18 +128,23 @@ class HistoryReplicator:
                 raise RetryReplicationError(1, task.first_event_id)
             domain = self._domain_entry(task.domain_id)
             ms = MutableState(domain)
-        next_id = ms.execution_info.next_event_id
-        if task.first_event_id < next_id:
-            return False  # already applied (dedup / at-least-once delivery)
-        if task.first_event_id > next_id:
-            raise RetryReplicationError(next_id, task.first_event_id)
+        else:
+            next_id = ms.execution_info.next_event_id
+            if task.first_event_id < next_id:
+                return False  # already applied (dedup / at-least-once delivery)
+            if task.first_event_id > next_id:
+                raise RetryReplicationError(next_id, task.first_event_id)
+            ms = copy.deepcopy(ms)
 
         sb = StateBuilder(ms)
-        for batch in batches:
-            sb.apply_batch(batch)
-        key = (task.domain_id, task.workflow_id, task.run_id)
-        self._cache[key] = ms
+        try:
+            for batch in batches:
+                sb.apply_batch(batch)
+        except ReplayError:
+            self._cache.pop(key, None)
+            raise
         self._persist(ms, batches)
+        self._cache[key] = ms
         return True
 
     def _domain_entry(self, domain_id: str) -> DomainEntry:
@@ -146,20 +158,18 @@ class HistoryReplicator:
 
     def _persist(self, ms: MutableState, batches: List[HistoryBatch]) -> None:
         """UpdateWorkflowExecutionAsPassive analog: append history + upsert
-        the snapshot (no active-side conditional needed — the replicator is
-        the only writer on the standby)."""
+        the snapshot through the store API. Tasks generated during passive
+        apply are DISCARDED: a standby does not dispatch work, and a
+        promoted standby regenerates every task from mutable state via the
+        task refresher (mutable_state_task_refresher.go:77 analog in
+        engine/task_refresher.py) — persisting them here would flush stale
+        ghosts into the shard queues on the first post-failover commit."""
         info = ms.execution_info
         for batch in batches:
             self.stores.history.append_batch(info.domain_id, info.workflow_id,
                                              info.run_id, batch.events)
-        store = self.stores.execution
-        with store._lock:  # passive upsert, single writer
-            key = (info.domain_id, info.workflow_id, info.run_id)
-            store._executions[key] = ms
-            from .persistence import CurrentExecution
-            store._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
-                run_id=info.run_id, state=info.state,
-                close_status=info.close_status)
+        ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
+        self.stores.execution.upsert_workflow(ms)
 
 
 @dataclass
@@ -204,25 +214,38 @@ class ReplicationTaskProcessor:
         return len(tasks)
 
     def _resend(self, task: ReplicationTask, gap: RetryReplicationError) -> None:
-        """Pull the missing range and re-apply (history_resender.go:111)."""
+        """Pull the missing range and re-apply (history_resender.go:111).
+
+        Errors inside the resend get the same routing as the main loop:
+        ReplayError (or a still-unresolved gap) quarantines the original
+        task in the DLQ instead of crashing the pump and wedging the ack
+        index on the same task forever."""
         if self.source_history_reader is None:
             self.stores.queue.enqueue(
                 REPLICATION_DLQ, DLQEntry(task=task, error=str(gap)))
             return
         self.resends += 1
-        missing = self.source_history_reader(
-            task.domain_id, task.workflow_id, task.run_id,
-            gap.from_event_id, gap.to_event_id)
-        for batch in missing:
-            self.replicator.apply(ReplicationTask(
-                domain_id=task.domain_id, workflow_id=task.workflow_id,
-                run_id=task.run_id, first_event_id=batch.events[0].id,
-                next_event_id=batch.events[-1].id + 1,
-                version=batch.events[-1].version,
-                events_blob=serialize_history([batch]),
-            ))
-        self.replicator.apply(task)
-        self.applied += 1
+        try:
+            missing = self.source_history_reader(
+                task.domain_id, task.workflow_id, task.run_id,
+                gap.from_event_id, gap.to_event_id)
+            for batch in missing:
+                self.replicator.apply(ReplicationTask(
+                    domain_id=task.domain_id, workflow_id=task.workflow_id,
+                    run_id=task.run_id, first_event_id=batch.events[0].id,
+                    next_event_id=batch.events[-1].id + 1,
+                    version=batch.events[-1].version,
+                    events_blob=serialize_history([batch]),
+                ))
+            applied = self.replicator.apply(task)
+        except (RetryReplicationError, ReplayError) as err:
+            self.stores.queue.enqueue(
+                REPLICATION_DLQ, DLQEntry(task=task, error=str(err)))
+            return
+        if applied:
+            self.applied += 1
+        else:
+            self.deduped += 1
 
     # -- DLQ surface (replication/dlq_handler.go read/purge/merge) ---------
 

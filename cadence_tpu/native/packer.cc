@@ -86,13 +86,28 @@ enum AttrCode : uint8_t {
   kARetryCoeffMilli = 26,
   kARetryMaxInterval = 27,
   kARetryMaxAttempts = 28,
-  kMaxAttrCode = 29,
+  // routing/lineage strings (codec.py round 2): carried for host-side
+  // fidelity, not lane material — skipped after length read
+  kATaskList = 29,        // string
+  kAWorkflowType = 30,    // string
+  kACronSchedule = 31,    // string
+  kAFirstExecRunId = 32,  // string
+  kARequestId = 33,       // string
+  kATargetWorkflowId = 34,  // string
+  kATargetRunId = 35,       // string
+  kATargetDomainId = 36,    // string
+  kASignalName = 37,        // string
+  kANewRunId = 38,          // string
+  kAParentClosePolicy = 39,
+  kAChildWfOnly = 40,
+  kMaxAttrCode = 41,
 };
 
 inline bool IsStringCode(uint8_t code) {
   return code == kAActivityId || code == kATimerId ||
          code == kAParentWorkflowId || code == kAParentRunId ||
-         code == kAParentDomainId;
+         code == kAParentDomainId ||
+         (code >= kATaskList && code <= kANewRunId);
 }
 
 struct Cursor {

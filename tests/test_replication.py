@@ -152,6 +152,56 @@ class TestFailover:
         items = ms.version_histories.current().items
         assert [i.version for i in items] == [1, 12]
 
+    def test_failover_with_inflight_activity(self, clusters):
+        """Activity scheduled (dispatched, never started) on the active;
+        after failover the promoted standby must regenerate the activity
+        transfer task (RefreshTasks) so a standby-side worker can run it."""
+        from cadence_tpu.models.deciders import EchoDecider
+        box = clusters.active
+        box.frontend.start_workflow_execution(DOMAIN, "xdc-act", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"xdc-act": EchoDecider(TL)})
+        box.pump_once()                       # decision → matching
+        assert poller.poll_and_decide_once()  # schedules the activity
+        box.pump_once()                       # activity task → active matching
+        clusters.replicate()
+        clusters.failover(DOMAIN, "standby")
+
+        sbox = clusters.standby
+        spoller = TaskPoller(sbox, DOMAIN, TL, {"xdc-act": EchoDecider(TL)})
+        spoller.drain()
+        ms = sbox.frontend.describe_workflow_execution(DOMAIN, "xdc-act")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        # the activity ran exactly once, on the standby side
+        events = sbox.frontend.get_workflow_execution_history(DOMAIN, "xdc-act")
+        starts = [e for e in events
+                  if e.event_type.name == "ActivityTaskStarted"]
+        assert len(starts) == 1
+        assert starts[0].version == 12  # post-failover version
+
+    def test_failover_with_pending_user_timer(self, clusters):
+        """User timer started on the active fires on the promoted standby:
+        the refresher must recreate the UserTimer task in the standby's
+        timer queue with the original expiry."""
+        from cadence_tpu.models.deciders import TimerDecider
+        box = clusters.active
+        box.frontend.start_workflow_execution(DOMAIN, "xdc-timer", "timer", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"xdc-timer": TimerDecider(fire_seconds=5)})
+        box.pump_once()
+        assert poller.poll_and_decide_once()  # starts the 5s timer
+        clusters.replicate()
+        clusters.failover(DOMAIN, "standby")
+
+        sbox = clusters.standby
+        spoller = TaskPoller(sbox, DOMAIN, TL,
+                             {"xdc-timer": TimerDecider(fire_seconds=5)})
+        sbox.advance_time(6)
+        spoller.drain()
+        ms = sbox.frontend.describe_workflow_execution(DOMAIN, "xdc-timer")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        events = sbox.frontend.get_workflow_execution_history(DOMAIN, "xdc-timer")
+        assert any(e.event_type.name == "TimerFired" for e in events)
+
 
 class TestStreamingReplay:
     def test_chunked_matches_single_shot(self):
