@@ -80,9 +80,10 @@ class HistoryEngine:
         self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
 
     def _publish_replication(self, domain_id: str, workflow_id: str,
-                             run_id: str, events) -> None:
+                             run_id: str, events, ms: MutableState) -> None:
         """insertReplicationTasks analog: global domains stream every
-        committed batch to remote clusters."""
+        committed batch to remote clusters, carrying the source branch's
+        version-history items for NDC branch selection."""
         pub = self.replication_publisher_holder.get("pub")
         if pub is None:
             return
@@ -91,7 +92,10 @@ class HistoryEngine:
                 return
         except EntityNotExistsError:
             return
-        pub.publish(domain_id, workflow_id, run_id, events)
+        items = tuple((i.event_id, i.version)
+                      for i in ms.version_histories.current().items)
+        pub.publish(domain_id, workflow_id, run_id, events,
+                    version_history_items=items)
 
     # ------------------------------------------------------------------
     # transaction plumbing
@@ -181,7 +185,7 @@ class HistoryEngine:
         self.shard.insert_tasks(domain_id, workflow_id, run_id,
                                 ms.transfer_tasks, ms.timer_tasks)
         ms.transfer_tasks, ms.timer_tasks = [], []
-        self._publish_replication(domain_id, workflow_id, run_id, events)
+        self._publish_replication(domain_id, workflow_id, run_id, events, ms)
         return run_id
 
     # ------------------------------------------------------------------
@@ -692,6 +696,6 @@ class _Txn:
             info.domain_id, info.workflow_id, info.run_id,
             new_transfer, new_timer)
         self.engine._publish_replication(info.domain_id, info.workflow_id,
-                                         info.run_id, self.events)
+                                         info.run_id, self.events, self.ms)
         for fn in self._post:
             fn()

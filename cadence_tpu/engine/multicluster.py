@@ -21,6 +21,24 @@ from .replication import (
 )
 
 
+def _refresh_domain_tasks(box: Onebox, domain_name: str) -> None:
+    """Promotion sweep: regenerate tasks for every CURRENT run of the
+    domain. Zombie runs (persisted but not holding the current-run pointer
+    after NDC arbitration) are skipped — refreshing them would dispatch
+    work for a run that lost, executing the same workflow twice."""
+    from .persistence import EntityNotExistsError
+    domain_id = box.stores.domain.by_name(domain_name).domain_id
+    for d_id, wf_id, run_id in \
+            box.stores.execution.list_domain_executions(domain_id):
+        try:
+            current = box.stores.execution.get_current_run_id(d_id, wf_id)
+        except EntityNotExistsError:
+            continue
+        if current != run_id:
+            continue  # zombie run
+        box.route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+
+
 class ReplicatedClusters:
     def __init__(self, num_hosts: int = 1, num_shards: int = 4,
                  metadata: Optional[ClusterMetadata] = None) -> None:
@@ -35,12 +53,30 @@ class ReplicatedClusters:
         self.processor = ReplicationTaskProcessor(
             self.replicator, self.publisher, self.standby.stores,
             source_history_reader=self._read_source_history)
+        # reverse direction (standby → active): every cluster in an NDC
+        # group both publishes and consumes (task_fetcher.go polls every
+        # remote cluster); needed for post-split-brain reconciliation
+        self.reverse_publisher = ReplicationPublisher(self.standby.stores)
+        self.standby.set_replication_publisher(self.reverse_publisher)
+        self.reverse_replicator = HistoryReplicator(self.active.stores)
+        self.reverse_processor = ReplicationTaskProcessor(
+            self.reverse_replicator, self.reverse_publisher,
+            self.active.stores,
+            source_history_reader=self._read_standby_history)
 
     def _read_source_history(self, domain_id: str, workflow_id: str,
                              run_id: str, from_event_id: int,
                              to_event_id: int) -> List[HistoryBatch]:
         """Admin GetWorkflowExecutionRawHistoryV2 analog for the resender."""
         batches = self.active.stores.history.as_history_batches(
+            domain_id, workflow_id, run_id)
+        return [b for b in batches
+                if from_event_id <= b.events[0].id < to_event_id]
+
+    def _read_standby_history(self, domain_id: str, workflow_id: str,
+                              run_id: str, from_event_id: int,
+                              to_event_id: int) -> List[HistoryBatch]:
+        batches = self.standby.stores.history.as_history_batches(
             domain_id, workflow_id, run_id)
         return [b for b in batches
                 if from_event_id <= b.events[0].id < to_event_id]
@@ -66,6 +102,46 @@ class ReplicatedClusters:
             if n == 0:
                 return total
 
+    def replicate_reverse(self) -> int:
+        """Drain the standby's outbound stream into the active cluster."""
+        total = 0
+        while True:
+            n = self.reverse_processor.process_once()
+            total += n
+            if n == 0:
+                return total
+
+    def split_brain_promote(self, domain_name: str) -> int:
+        """NON-graceful failover: ONLY the standby learns it is active (the
+        old active keeps writing at its version) — the divergence generator
+        for NDC conflict-resolution tests (host/ndc/integration_test.go
+        crafts the same shape with conflicting event batches). Returns the
+        standby's new failover version."""
+        d = self.standby.stores.domain.by_name(domain_name)
+        new_version = self.meta.next_failover_version(
+            "standby", d.failover_version)
+        d.failover_version = new_version
+        d.active_cluster = "standby"
+        d.is_active = True
+        self.standby.stores.domain.update(d)
+        _refresh_domain_tasks(self.standby, domain_name)
+        return new_version
+
+    def heal(self, domain_name: str, active_cluster: str = "standby") -> None:
+        """Post-split-brain reconnection: converge domain metadata to the
+        winner, then drain both replication directions so conflict
+        resolution runs on both sides."""
+        winner = (self.standby if active_cluster == "standby"
+                  else self.active).stores.domain.by_name(domain_name)
+        for box in (self.active, self.standby):
+            d = box.stores.domain.by_name(domain_name)
+            d.failover_version = winner.failover_version
+            d.active_cluster = active_cluster
+            d.is_active = box.cluster_name == active_cluster
+            box.stores.domain.update(d)
+        self.replicate()
+        self.replicate_reverse()
+
     def failover(self, domain_name: str, to_cluster: str = "standby") -> int:
         """Graceful failover: bump the domain failover version into the
         target cluster's slot on BOTH clusters (domain metadata replication
@@ -86,8 +162,5 @@ class ReplicatedClusters:
         # without this, pre-failover pending work (in-flight activities,
         # user timers, pending decisions) never runs on the new active side.
         promoted = self.standby if to_cluster == "standby" else self.active
-        domain_id = promoted.stores.domain.by_name(domain_name).domain_id
-        for d_id, wf_id, run_id in \
-                promoted.stores.execution.list_domain_executions(domain_id):
-            promoted.route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+        _refresh_domain_tasks(promoted, domain_name)
         return new_version

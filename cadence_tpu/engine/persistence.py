@@ -94,47 +94,100 @@ class ShardStore:
 
 
 class HistoryStore:
+    """Branched event-batch store (historyManager.go tree/branch model).
+
+    Each run holds a list of branches; branch 0 is created on first append.
+    A branch is a strictly-contiguous list of event batches. `fork_branch`
+    is the ForkHistoryBranch analog (nosqlHistoryStore.go:238): the new
+    branch copies the source up to the fork event (splitting a batch when
+    the fork lands mid-batch). The per-run current-branch pointer tracks
+    NDC conflict resolution (which branch the mutable state follows);
+    callers that pass branch=None read/append the current branch."""
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        #: (domain_id, workflow_id, run_id) -> list of event batches
-        self._branches: Dict[Tuple[str, str, str], List[List[HistoryEvent]]] = {}
+        #: (domain_id, workflow_id, run_id) -> list of branches, each a
+        #: list of event batches
+        self._branches: Dict[Tuple[str, str, str], List[List[List[HistoryEvent]]]] = {}
+        self._current: Dict[Tuple[str, str, str], int] = {}
 
     def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
-                     events: List[HistoryEvent]) -> None:
+                     events: List[HistoryEvent],
+                     branch: Optional[int] = None) -> None:
         if not events:
             raise ValueError("empty history batch")
         key = (domain_id, workflow_id, run_id)
         with self._lock:
-            branch = self._branches.setdefault(key, [])
-            if branch:
-                expected = branch[-1][-1].id + 1
+            branches = self._branches.setdefault(key, [[]])
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                raise EntityNotExistsError(f"no branch {index} for {key}")
+            target = branches[index]
+            if target:
+                expected = target[-1][-1].id + 1
                 if events[0].id != expected:
                     raise ConditionFailedError(
                         f"history append out of order: got first id "
                         f"{events[0].id}, expected {expected}"
                     )
-            branch.append(list(events))
+            target.append(list(events))
 
-    def read_batches(self, domain_id: str, workflow_id: str, run_id: str
-                     ) -> List[List[HistoryEvent]]:
+    def fork_branch(self, domain_id: str, workflow_id: str, run_id: str,
+                    source_branch: int, fork_event_id: int) -> int:
+        """New branch = source's batches up to and including fork_event_id;
+        returns the new branch index (ForkHistoryBranch analog)."""
+        key = (domain_id, workflow_id, run_id)
         with self._lock:
-            branch = self._branches.get((domain_id, workflow_id, run_id))
-            if branch is None:
-                raise EntityNotExistsError(f"no history for {workflow_id}/{run_id}")
-            return [list(b) for b in branch]
+            branches = self._branches.get(key)
+            if branches is None or source_branch >= len(branches):
+                raise EntityNotExistsError(f"no branch {source_branch} for {key}")
+            forked: List[List[HistoryEvent]] = []
+            for batch in branches[source_branch]:
+                if batch[-1].id <= fork_event_id:
+                    forked.append(list(batch))
+                else:
+                    partial = [e for e in batch if e.id <= fork_event_id]
+                    if partial:
+                        forked.append(partial)
+                    break
+            branches.append(forked)
+            return len(branches) - 1
 
-    def read_events(self, domain_id: str, workflow_id: str, run_id: str
-                    ) -> List[HistoryEvent]:
-        return [e for b in self.read_batches(domain_id, workflow_id, run_id)
+    def set_current_branch(self, domain_id: str, workflow_id: str,
+                           run_id: str, branch: int) -> None:
+        with self._lock:
+            self._current[(domain_id, workflow_id, run_id)] = branch
+
+    def get_current_branch(self, domain_id: str, workflow_id: str,
+                           run_id: str) -> int:
+        with self._lock:
+            return self._current.get((domain_id, workflow_id, run_id), 0)
+
+    def read_batches(self, domain_id: str, workflow_id: str, run_id: str,
+                     branch: Optional[int] = None) -> List[List[HistoryEvent]]:
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branches = self._branches.get(key)
+            if branches is None:
+                raise EntityNotExistsError(f"no history for {workflow_id}/{run_id}")
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                raise EntityNotExistsError(f"no branch {index} for {key}")
+            return [list(b) for b in branches[index]]
+
+    def read_events(self, domain_id: str, workflow_id: str, run_id: str,
+                    branch: Optional[int] = None) -> List[HistoryEvent]:
+        return [e for b in self.read_batches(domain_id, workflow_id, run_id,
+                                             branch)
                 for e in b]
 
-    def as_history_batches(self, domain_id: str, workflow_id: str, run_id: str
-                           ) -> List[HistoryBatch]:
+    def as_history_batches(self, domain_id: str, workflow_id: str, run_id: str,
+                           branch: Optional[int] = None) -> List[HistoryBatch]:
         """Batches in the replay-input shape (for the TPU kernel path)."""
         return [
             HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
                          run_id=run_id, events=b)
-            for b in self.read_batches(domain_id, workflow_id, run_id)
+            for b in self.read_batches(domain_id, workflow_id, run_id, branch)
         ]
 
 
@@ -214,18 +267,21 @@ class ExecutionStore:
                     close_status=info.close_status,
                 )
 
-    def upsert_workflow(self, ms: MutableState) -> None:
+    def upsert_workflow(self, ms: MutableState, set_current: bool = True) -> None:
         """UpdateWorkflowExecutionAsPassive analog: unconditional snapshot
-        upsert + current-run pointer, used by the standby-side replicator
-        (the replicator is the single writer on a passive cluster, so no
-        range-ID fence or next-event-id condition applies)."""
+        upsert, used by the standby-side replicator (the replicator is the
+        only writer on a passive cluster, so no range-ID fence or
+        next-event-id condition applies). `set_current=False` persists the
+        run WITHOUT taking the current-run pointer — the zombie-run seat
+        (ndc/transaction_manager.go createAsZombie)."""
         info = ms.execution_info
         with self._lock:
             self._executions[(info.domain_id, info.workflow_id, info.run_id)] = ms
-            self._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
-                run_id=info.run_id, state=info.state,
-                close_status=info.close_status,
-            )
+            if set_current:
+                self._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
+                    run_id=info.run_id, state=info.state,
+                    close_status=info.close_status,
+                )
 
     def get_workflow(self, domain_id: str, workflow_id: str, run_id: str
                      ) -> MutableState:

@@ -34,10 +34,28 @@ REPLICATION_QUEUE = "replication"
 REPLICATION_DLQ = "replication-dlq"
 
 
+def _items_until(items: Tuple[Tuple[int, int], ...], event_id: int
+                 ) -> Tuple[Tuple[int, int], ...]:
+    """Version-history items describing only events <= event_id (the
+    DuplicateUntilLCAItem shape applied to wire tuples)."""
+    out = []
+    for ev, version in items:
+        if ev <= event_id:
+            out.append((ev, version))
+        else:
+            out.append((event_id, version))
+            break
+    return tuple(out)
+
+
 @dataclass
 class ReplicationTask:
     """One history batch crossing the cluster boundary
-    (types.ReplicationTask/HistoryTaskV2Attributes analog)."""
+    (types.ReplicationTask/HistoryTaskV2Attributes analog).
+
+    `version_history_items` is the source branch's version history at send
+    time ((event_id, version) pairs) — the NDC branch-selection input
+    (ndc/replication_task.go:93 parses the same field)."""
 
     domain_id: str
     workflow_id: str
@@ -46,6 +64,7 @@ class ReplicationTask:
     next_event_id: int
     version: int
     events_blob: bytes  # codec-serialized single batch
+    version_history_items: Tuple[Tuple[int, int], ...] = ()
 
 
 class RetryReplicationError(Exception):
@@ -66,7 +85,8 @@ class ReplicationPublisher:
         self.stores = stores
 
     def publish(self, domain_id: str, workflow_id: str, run_id: str,
-                events: List[HistoryEvent]) -> None:
+                events: List[HistoryEvent],
+                version_history_items: Tuple[Tuple[int, int], ...] = ()) -> None:
         batch = HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
                              run_id=run_id, events=events)
         task = ReplicationTask(
@@ -74,6 +94,7 @@ class ReplicationPublisher:
             first_event_id=events[0].id, next_event_id=events[-1].id + 1,
             version=events[-1].version,
             events_blob=serialize_history([batch]),
+            version_history_items=version_history_items,
         )
         self.stores.queue.enqueue(REPLICATION_QUEUE, task)
 
@@ -86,27 +107,38 @@ class ReplicationPublisher:
 class HistoryReplicator:
     """Target side: apply replicated batches to the standby cluster's state.
 
-    Implements the linear-lineage NDC subset: contiguity via next-event-id,
-    stale-task dedup, version monotonicity via version histories (enforced
-    by the state builder), gap → RetryReplicationError for the resender.
-    Divergent-branch conflict resolution (branch forks) is the documented
-    round-2 extension (ndc/branch_manager.go)."""
+    Full NDC semantics (ndc/history_replicator.go:183 applyEvents):
+
+    - branch selection: the incoming batch carries the source branch's
+      version-history items; the local branch with the deepest common
+      ancestor receives it (branch_manager.go:87 prepareVersionHistory);
+    - divergence: when the LCA is mid-branch, fork a new branch at the LCA
+      (versionHistory DuplicateUntilLCAItem + store ForkHistoryBranch) and
+      append there;
+    - conflict resolution: events landing on a non-current branch are
+      persisted without touching mutable state; when that branch's last
+      write version overtakes the current branch's, the mutable state is
+      REBUILT by replaying the winning branch (conflict_resolver.go +
+      state_rebuilder.go — the bulk analog is the TPU replay engine) and
+      the current pointer switches;
+    - run-level arbitration: a replicated run only takes the current-run
+      pointer when it wins by version (zombie runs stay persisted but
+      non-current, transaction_manager.go createAsZombie analog);
+    - contiguity per branch: dedup below the branch head,
+      RetryReplicationError gaps for the resender."""
 
     def __init__(self, stores: Stores) -> None:
         self.stores = stores
-        #: in-flight mutable states (the execution cache analog); flushed
-        #: through the standby stores on every apply
-        self._cache: Dict[Tuple[str, str, str], MutableState] = {}
 
     def _load(self, task: ReplicationTask) -> Optional[MutableState]:
+        """Always read the store: on an active cluster the local engine
+        writes the same executions, so a replicator-private cache goes
+        stale exactly when conflict resolution matters (the reference
+        shares ONE execution cache between engine and replicator with
+        per-execution locks; store-direct reads give the same coherence)."""
         key = (task.domain_id, task.workflow_id, task.run_id)
-        ms = self._cache.get(key)
-        if ms is not None:
-            return ms
         try:
-            ms = self.stores.execution.get_workflow(*key)
-            self._cache[key] = ms
-            return ms
+            return self.stores.execution.get_workflow(*key)
         except EntityNotExistsError:
             return None
 
@@ -126,26 +158,139 @@ class HistoryReplicator:
             if task.first_event_id != 1:
                 # first batch missing: pull history from the start
                 raise RetryReplicationError(1, task.first_event_id)
-            domain = self._domain_entry(task.domain_id)
-            ms = MutableState(domain)
-        else:
-            next_id = ms.execution_info.next_event_id
-            if task.first_event_id < next_id:
-                return False  # already applied (dedup / at-least-once delivery)
-            if task.first_event_id > next_id:
-                raise RetryReplicationError(next_id, task.first_event_id)
-            ms = copy.deepcopy(ms)
+            ms = MutableState(self._domain_entry(task.domain_id))
+            return self._apply_to_current(key, ms, task, batches)
+        ms = copy.deepcopy(ms)
 
+        # -- branch selection (branch_manager.go:87 prepareVersionHistory) --
+        vhs = ms.version_histories
+        incoming = self._incoming_items(task)
+        branch_index, lca = vhs.find_lca_index_and_item(incoming)
+        local = vhs.histories[branch_index]
+        appendable = local.is_lca_appendable(lca)
+        if appendable:
+            expected_next = local.last_item().event_id + 1
+        else:
+            expected_next = lca.event_id + 1  # a fresh fork would end at LCA
+        if task.first_event_id < expected_next:
+            return False  # branch already holds these events (dedup)
+        if task.first_event_id > expected_next:
+            raise RetryReplicationError(expected_next, task.first_event_id)
+
+        fork_spec = None
+        if not appendable:
+            # divergence: fork at the LCA. Only the SCRATCH version history
+            # is touched here; the store branch is created later, after
+            # every fallible step, so a failed apply never leaves an orphan
+            # store branch that would skew branch indices on retry.
+            forked_items = local.duplicate_until_lca(lca)
+            fork_spec = (branch_index, lca.event_id)
+            vhs.histories.append(forked_items)
+            branch_index = len(vhs.histories) - 1
+
+        if branch_index == vhs.current_index:
+            return self._apply_to_current(key, ms, task, batches)
+        return self._apply_to_noncurrent(key, ms, task, batches, branch_index,
+                                         fork_spec)
+
+    @staticmethod
+    def _incoming_items(task: ReplicationTask):
+        from ..oracle.mutable_state import VersionHistoryItem
+        if task.version_history_items:
+            return [VersionHistoryItem(e, v)
+                    for e, v in task.version_history_items]
+        # legacy tasks without items: a linear history ending at this batch
+        return [VersionHistoryItem(task.next_event_id - 1, task.version)]
+
+    def _apply_to_current(self, key, ms: MutableState, task: ReplicationTask,
+                          batches: List[HistoryBatch]) -> bool:
+        """Current-branch path: replay through the state builder (the hot
+        loop the TPU kernel batches) and persist state + history."""
         sb = StateBuilder(ms)
-        try:
-            for batch in batches:
-                sb.apply_batch(batch)
-        except ReplayError:
-            self._cache.pop(key, None)
-            raise
+        for batch in batches:
+            sb.apply_batch(batch)
         self._persist(ms, batches)
-        self._cache[key] = ms
         return True
+
+    def _apply_to_noncurrent(self, key, ms: MutableState,
+                             task: ReplicationTask,
+                             batches: List[HistoryBatch],
+                             branch_index: int,
+                             fork_spec: Optional[tuple]) -> bool:
+        """Non-current-branch path: persist events without touching live
+        state; then resolve the conflict if this branch now wins by version
+        (conflict_resolver.go prepareMutableState).
+
+        Ordering discipline: every fallible step (item bookkeeping, the
+        conflict-resolution replay) runs against scratch state / in-memory
+        batches FIRST; store mutations (fork, append, pointer switch,
+        upsert) happen only once nothing can fail, so a poison batch leaves
+        the store untouched and a retry starts clean."""
+        vhs = ms.version_histories
+        branch = vhs.histories[branch_index]
+        for batch in batches:
+            for event in batch.events:
+                branch.add_or_update_item(event.id, event.version)
+
+        # branch contents in memory: (forked prefix | persisted branch) +
+        # the incoming batches — needed fallibly for the rebuild below
+        rebuilt = None
+        if branch.last_item().version > vhs.current().last_item().version:
+            if fork_spec is not None:
+                source_branch, fork_event_id = fork_spec
+                base = [
+                    HistoryBatch(domain_id=key[0], workflow_id=key[1],
+                                 run_id=key[2], events=b)
+                    for b in self._forked_batches(key, source_branch,
+                                                  fork_event_id)
+                ]
+            else:
+                base = self.stores.history.as_history_batches(
+                    *key, branch=branch_index)
+            rebuilt = StateBuilder(
+                MutableState(self._domain_entry(key[0]))).replay_history(
+                    base + list(batches))
+
+        # -- store mutations: nothing below raises on valid input ----------
+        if fork_spec is not None:
+            source_branch, fork_event_id = fork_spec
+            store_index = self.stores.history.fork_branch(
+                *key, source_branch=source_branch,
+                fork_event_id=fork_event_id)
+            if store_index != branch_index:
+                raise ReplayError(
+                    f"branch index skew: store {store_index} != "
+                    f"version-history {branch_index}")
+        for batch in batches:
+            self.stores.history.append_batch(*key, events=batch.events,
+                                             branch=branch_index)
+        if rebuilt is not None:
+            # conflict resolution: winning branch becomes current
+            # (state_rebuilder.go full replay; bulk analog: TPUReplayEngine)
+            vhs.histories[branch_index] = rebuilt.version_histories.current()
+            rebuilt.version_histories = vhs
+            vhs.current_index = branch_index
+            self.stores.history.set_current_branch(*key, branch=branch_index)
+            rebuilt.transfer_tasks, rebuilt.timer_tasks = [], []
+            rebuilt.cross_cluster_tasks = []
+            ms = rebuilt
+        self.stores.execution.upsert_workflow(
+            ms, set_current=self._wins_current(key, ms))
+        return True
+
+    def _forked_batches(self, key, source_branch: int, fork_event_id: int):
+        """The fork's prefix batches (source branch up to the fork event),
+        without materializing the fork in the store."""
+        out = []
+        for b in self.stores.history.read_batches(*key, branch=source_branch):
+            if b[-1].id <= fork_event_id:
+                out.append(b)
+            else:
+                partial = [e for e in b if e.id <= fork_event_id]
+                if partial:
+                    out.append(partial)
+                break
+        return out
 
     def _domain_entry(self, domain_id: str) -> DomainEntry:
         try:
@@ -165,11 +310,39 @@ class HistoryReplicator:
         engine/task_refresher.py) — persisting them here would flush stale
         ghosts into the shard queues on the first post-failover commit."""
         info = ms.execution_info
+        key = (info.domain_id, info.workflow_id, info.run_id)
+        branch = ms.version_histories.current_index
         for batch in batches:
-            self.stores.history.append_batch(info.domain_id, info.workflow_id,
-                                             info.run_id, batch.events)
+            self.stores.history.append_batch(*key, events=batch.events,
+                                             branch=branch)
         ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
-        self.stores.execution.upsert_workflow(ms)
+        self.stores.execution.upsert_workflow(
+            ms, set_current=self._wins_current(key, ms))
+
+    def _wins_current(self, key, ms: MutableState) -> bool:
+        """Run-level arbitration (transaction_manager.go create-as-current
+        vs create-as-zombie): a replicated run takes the current-run pointer
+        unless a DIFFERENT open run with a higher last-write version already
+        holds it."""
+        from ..core.enums import WorkflowState
+        domain_id, workflow_id, run_id = key
+        try:
+            cur_run = self.stores.execution.get_current_run_id(
+                domain_id, workflow_id)
+        except EntityNotExistsError:
+            return True
+        if cur_run == run_id:
+            return True
+        try:
+            cur_ms = self.stores.execution.get_workflow(
+                domain_id, workflow_id, cur_run)
+        except EntityNotExistsError:
+            return True
+        if cur_ms.execution_info.state == WorkflowState.Completed:
+            # a closed current run yields to an open incoming run
+            return ms.execution_info.state != WorkflowState.Completed \
+                or ms.get_last_write_version() >= cur_ms.get_last_write_version()
+        return ms.get_last_write_version() > cur_ms.get_last_write_version()
 
 
 @dataclass
@@ -230,12 +403,18 @@ class ReplicationTaskProcessor:
                 task.domain_id, task.workflow_id, task.run_id,
                 gap.from_event_id, gap.to_event_id)
             for batch in missing:
+                last_id = batch.events[-1].id
                 self.replicator.apply(ReplicationTask(
                     domain_id=task.domain_id, workflow_id=task.workflow_id,
                     run_id=task.run_id, first_event_id=batch.events[0].id,
-                    next_event_id=batch.events[-1].id + 1,
+                    next_event_id=last_id + 1,
                     version=batch.events[-1].version,
                     events_blob=serialize_history([batch]),
+                    # the missing range is a prefix of the original task's
+                    # branch: its items capped at this batch's last event
+                    # keep NDC branch selection working on divergent runs
+                    version_history_items=_items_until(
+                        task.version_history_items, last_id),
                 ))
             applied = self.replicator.apply(task)
         except (RetryReplicationError, ReplayError) as err:

@@ -90,6 +90,47 @@ class VersionHistory:
         else:
             last.event_id = event_id
 
+    def find_lca_item(self, remote_items: List[VersionHistoryItem]
+                      ) -> VersionHistoryItem:
+        """Lowest common ancestor of this branch vs a remote item list
+        (versionHistory.go:239-271 FindLCAItem): walk both item lists from
+        the tail; the first version match contributes min(event_id)."""
+        li = len(self.items) - 1
+        ri = len(remote_items) - 1
+        while li >= 0 and ri >= 0:
+            local = self.items[li]
+            remote = remote_items[ri]
+            if local.version == remote.version:
+                return VersionHistoryItem(
+                    min(local.event_id, remote.event_id), local.version)
+            if local.version > remote.version:
+                li -= 1
+            else:
+                ri -= 1
+        raise ReplayError("version histories have no common ancestor")
+
+    def is_lca_appendable(self, lca: VersionHistoryItem) -> bool:
+        """versionHistory.go:227-237: the remote branch extends this one
+        iff the LCA is this branch's last item."""
+        last = self.last_item()
+        return last.event_id == lca.event_id and last.version == lca.version
+
+    def duplicate_until_lca(self, lca: VersionHistoryItem) -> "VersionHistory":
+        """versionHistory.go:136-158 DuplicateUntilLCAItem: the fork's item
+        list — every item strictly below the LCA version plus the LCA-capped
+        item of its version."""
+        items: List[VersionHistoryItem] = []
+        for item in self.items:
+            if item.version < lca.version and item.event_id <= lca.event_id:
+                items.append(VersionHistoryItem(item.event_id, item.version))
+            elif item.version == lca.version:
+                items.append(VersionHistoryItem(
+                    min(item.event_id, lca.event_id), item.version))
+                return VersionHistory(items=items)
+            else:
+                break
+        raise ReplayError(f"version history cannot be forked at {lca}")
+
 
 @dataclass(slots=True)
 class VersionHistories:
@@ -98,6 +139,25 @@ class VersionHistories:
 
     def current(self) -> VersionHistory:
         return self.histories[self.current_index]
+
+    def find_lca_index_and_item(self, remote_items: List[VersionHistoryItem]
+                                ) -> tuple:
+        """versionHistories.go FindLCAVersionHistoryIndexAndItem: the local
+        branch sharing the deepest common ancestor with the remote items."""
+        best_index = -1
+        best_item: Optional[VersionHistoryItem] = None
+        for index, history in enumerate(self.histories):
+            if history.is_empty():
+                continue
+            try:
+                item = history.find_lca_item(remote_items)
+            except ReplayError:
+                continue
+            if best_item is None or item.event_id > best_item.event_id:
+                best_index, best_item = index, item
+        if best_item is None:
+            raise ReplayError("no local branch shares an ancestor with remote")
+        return best_index, best_item
 
 
 # ---------------------------------------------------------------------------

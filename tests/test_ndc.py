@@ -1,0 +1,165 @@
+"""NDC divergent-branch conflict resolution tests.
+
+Reference tier: host/ndc/integration_test.go — conflicting event suffixes
+written by two clusters after a non-graceful failover must converge: both
+sides fork at the common ancestor, keep both branches, and switch current
+to the higher-version branch; device replay of the winning branch matches
+the oracle state (BASELINE north-star parity on the NDC path)."""
+import pytest
+
+from cadence_tpu.core.checksum import payload_row
+from cadence_tpu.core.enums import CloseStatus
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from cadence_tpu.models.deciders import SignalDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "ndc-domain"
+TL = "ndc-tasklist"
+WF = "ndc-split"
+
+
+@pytest.fixture()
+def clusters():
+    c = ReplicatedClusters(num_hosts=1, num_shards=4)
+    c.register_global_domain(DOMAIN)
+    return c
+
+
+def _start_and_replicate(clusters, expected_signals=2):
+    """Common prefix on the active, replicated to the standby."""
+    box = clusters.active
+    box.frontend.start_workflow_execution(DOMAIN, WF, "signal", TL)
+    poller = TaskPoller(box, DOMAIN, TL,
+                        {WF: SignalDecider(expected_signals=expected_signals)})
+    poller.drain()  # first decision completes; workflow awaits signals
+    clusters.replicate()
+    domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+    run_id = box.stores.execution.get_current_run_id(domain_id, WF)
+    return domain_id, run_id
+
+
+class TestDivergence:
+    def test_split_brain_converges_to_higher_version_branch(self, clusters):
+        domain_id, run_id = _start_and_replicate(clusters)
+        prefix_end = clusters.active.stores.execution.get_workflow(
+            domain_id, WF, run_id).execution_info.next_event_id - 1
+
+        # non-graceful failover: standby promotes itself; active keeps going
+        new_version = clusters.split_brain_promote(DOMAIN)
+        assert new_version == 12
+
+        # active writes a v1 suffix (one signal, decider wants 2 → no close)
+        apoller = TaskPoller(clusters.active, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution(DOMAIN, WF, "a-1")
+        apoller.drain()
+
+        # standby writes a CONFLICTING v12 suffix that closes the workflow
+        spoller = TaskPoller(clusters.standby, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-1")
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-2")
+        spoller.drain()
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+        assert standby_ms.execution_info.close_status == CloseStatus.Completed
+
+        # heal: both directions drain; both converge to the v12 branch
+        clusters.heal(DOMAIN, "standby")
+
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+
+        # the v12 branch won on both sides
+        for ms in (active_ms, standby_ms):
+            assert ms.execution_info.close_status == CloseStatus.Completed
+            items = [(i.event_id, i.version)
+                     for i in ms.version_histories.current().items]
+            assert items[0] == (prefix_end, 1)
+            assert items[-1][1] == 12
+            # the losing v1 suffix is retained as a non-current branch
+            assert len(ms.version_histories.histories) == 2
+        # canonical state payloads identical across clusters
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+
+        # loser branch still ends at v1, beyond the fork point
+        for ms in (active_ms, standby_ms):
+            non_current = [h for i, h in enumerate(ms.version_histories.histories)
+                           if i != ms.version_histories.current_index][0]
+            assert non_current.last_item().version == 1
+            assert non_current.last_item().event_id > prefix_end
+
+    def test_winning_branch_replays_on_device(self, clusters):
+        """Device replay of the post-conflict current branch matches the
+        live mutable state on both clusters (kernel as the NDC bulk apply)."""
+        domain_id, run_id = _start_and_replicate(clusters)
+        clusters.split_brain_promote(DOMAIN)
+        apoller = TaskPoller(clusters.active, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution(DOMAIN, WF, "a-1")
+        apoller.drain()
+        spoller = TaskPoller(clusters.standby, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-1")
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-2")
+        spoller.drain()
+        clusters.heal(DOMAIN, "standby")
+
+        for box in (clusters.active, clusters.standby):
+            result = box.tpu.verify_all()
+            assert result.ok, f"{box.cluster_name}: {result}"
+            assert result.verified_on_device == result.total == 1
+
+    def test_lower_version_suffix_stays_non_current(self, clusters):
+        """The direction matters: when only the LOSER's suffix crosses the
+        wire, the winner's state must not move (no spurious rebuild)."""
+        domain_id, run_id = _start_and_replicate(clusters)
+        clusters.split_brain_promote(DOMAIN)
+        # active (v1, loser) write
+        apoller = TaskPoller(clusters.active, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution(DOMAIN, WF, "a-1")
+        apoller.drain()
+        # standby (v12) write
+        spoller = TaskPoller(clusters.standby, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-1")
+        spoller.drain()
+        before = payload_row(clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)).copy()
+
+        clusters.replicate()  # active → standby only (loser suffix arrives)
+
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+        assert (payload_row(standby_ms) == before).all()
+        assert len(standby_ms.version_histories.histories) == 2
+        assert standby_ms.version_histories.current().last_item().version == 12
+
+    def test_duplicate_divergent_delivery_deduped(self, clusters):
+        """Redelivering the loser's suffix after the fork must dedup against
+        the forked branch, not fork again."""
+        domain_id, run_id = _start_and_replicate(clusters)
+        clusters.split_brain_promote(DOMAIN)
+        apoller = TaskPoller(clusters.active, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution(DOMAIN, WF, "a-1")
+        apoller.drain()
+        spoller = TaskPoller(clusters.standby, DOMAIN, TL,
+                             {WF: SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, WF, "b-1")
+        spoller.drain()
+        clusters.replicate()
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+        branches_after = len(standby_ms.version_histories.histories)
+
+        # replay the whole active stream again (at-least-once delivery)
+        clusters.processor.ack_index = 0
+        clusters.replicate()
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, WF, run_id)
+        assert len(standby_ms.version_histories.histories) == branches_after
+        assert clusters.processor.deduped > 0
