@@ -81,13 +81,15 @@ A_SIGNAL_NAME = 37          # string
 A_NEW_RUN_ID = 38           # string ("new_execution_run_id", ContinuedAsNew)
 A_PARENT_CLOSE_POLICY = 39
 A_CHILD_WF_ONLY = 40        # "child_workflow_only" on external cancel/signal
+A_LAST_FAILURE_REASON = 41  # string; flushed transient ActivityTaskStarted
 
 STRING_CODES = frozenset({A_ACTIVITY_ID, A_TIMER_ID, A_PARENT_WORKFLOW_ID,
                           A_PARENT_RUN_ID, A_PARENT_DOMAIN_ID,
                           A_TASK_LIST, A_WORKFLOW_TYPE, A_CRON_SCHEDULE,
                           A_FIRST_EXEC_RUN_ID, A_REQUEST_ID,
                           A_TARGET_WORKFLOW_ID, A_TARGET_RUN_ID,
-                          A_TARGET_DOMAIN_ID, A_SIGNAL_NAME, A_NEW_RUN_ID})
+                          A_TARGET_DOMAIN_ID, A_SIGNAL_NAME, A_NEW_RUN_ID,
+                          A_LAST_FAILURE_REASON})
 
 _EV_HEAD = struct.Struct("<qBqqqB")  # id, type, version, ts, task_id, n_attrs
 _I64 = struct.Struct("<q")
@@ -151,6 +153,8 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
     elif et in (EventType.DecisionTaskStarted, EventType.ActivityTaskStarted):
         num(A_SCHED_EVENT_ID, "scheduled_event_id")
         string(A_REQUEST_ID, "request_id")
+        num(A_ATTEMPT, "attempt")
+        string(A_LAST_FAILURE_REASON, "last_failure_reason")
     elif et == EventType.DecisionTaskCompleted:
         num(A_SCHED_EVENT_ID, "scheduled_event_id")
         num(A_STARTED_EVENT_ID, "started_event_id")
@@ -323,4 +327,5 @@ _CODE_TO_KEY = {
     A_NEW_RUN_ID: "new_execution_run_id",
     A_PARENT_CLOSE_POLICY: "parent_close_policy",
     A_CHILD_WF_ONLY: "child_workflow_only",
+    A_LAST_FAILURE_REASON: "last_failure_reason",
 }

@@ -238,6 +238,8 @@ EMPTY_EVENT_ID = -23
 EMPTY_VERSION = -24
 END_EVENT_ID = (1 << 63) - 1
 BUFFERED_EVENT_ID = -123
+#: in-memory-only started marker for retrying activities whose started
+#: event is flushed lazily at close (common/constants.go:43)
 TRANSIENT_EVENT_ID = -124
 EMPTY_UUID = "emptyUuid"
 

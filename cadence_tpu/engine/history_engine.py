@@ -29,7 +29,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core.checksum import Checksum
 from ..core.enums import (
     EMPTY_EVENT_ID,
+    TRANSIENT_EVENT_ID,
     CloseStatus,
+    ContinueAsNewInitiator,
     DecisionType,
     EventType,
     TimeoutType,
@@ -50,13 +52,19 @@ class InvalidRequestError(Exception):
 @dataclass
 class TaskToken:
     """Opaque token tying a dispatched task to its workflow transaction
-    (reference: common taskToken serialized into matching responses)."""
+    (reference: common taskToken serialized into matching responses).
+
+    `attempt` disambiguates transient activity attempts: every transient
+    start reuses started_id == TRANSIENT_EVENT_ID, so without it a stale
+    worker's response for a superseded attempt would be accepted (the
+    reference token carries ScheduleAttempt for the same reason)."""
 
     domain_id: str
     workflow_id: str
     run_id: str
     schedule_id: int
     started_id: int = EMPTY_EVENT_ID
+    attempt: int = 0
 
 
 @dataclass
@@ -141,7 +149,10 @@ class HistoryEngine:
                        retry_policy: Optional[RetryPolicy] = None,
                        parent: Optional[Dict[str, Any]] = None,
                        request_id: Optional[str] = None,
-                       run_id: Optional[str] = None) -> str:
+                       run_id: Optional[str] = None,
+                       initiator: Optional[ContinueAsNewInitiator] = None,
+                       attempt: int = 0,
+                       expiration_timestamp: int = 0) -> str:
         run_id = run_id or str(uuid.uuid4())
         ms = MutableState(self._domain_entry(domain_id))
         version = ms.domain_entry.failover_version
@@ -158,6 +169,17 @@ class HistoryEngine:
             start_attrs["first_decision_task_backoff_seconds"] = first_decision_backoff
         if retry_policy is not None:
             start_attrs["retry_policy"] = retry_policy
+            if expiration_timestamp == 0 and retry_policy.expiration_interval_seconds:
+                # first run of a retrying workflow pins the chain's deadline
+                # (startWorkflowHelper expiration computation)
+                expiration_timestamp = now + \
+                    retry_policy.expiration_interval_seconds * 1_000_000_000
+        if initiator is not None:
+            start_attrs["initiator"] = int(initiator)
+        if attempt:
+            start_attrs["attempt"] = attempt
+        if expiration_timestamp:
+            start_attrs["expiration_timestamp"] = expiration_timestamp
         if parent:
             start_attrs.update(parent)
 
@@ -309,10 +331,29 @@ class HistoryEngine:
             txn.add(EventType.RequestCancelExternalWorkflowExecutionInitiated,
                     decision_task_completed_event_id=completed_id, **a)
         elif dt == DecisionType.CompleteWorkflowExecution:
+            # cron workflows re-run instead of closing
+            # (task_handler.go:436-460 handleDecisionCompleteWorkflow)
+            cron_backoff = self._cron_backoff_seconds(ms)
+            if cron_backoff >= 0:
+                self._retry_cron_continue(
+                    txn, ms, completed_id, a, cron_backoff,
+                    ContinueAsNewInitiator.CronSchedule)
+                return True
             txn.add(EventType.WorkflowExecutionCompleted,
                     decision_task_completed_event_id=completed_id, **a)
             return True
         elif dt == DecisionType.FailWorkflowExecution:
+            # workflow retry policy first, then cron
+            # (task_handler.go:517-545 handleDecisionFailWorkflow)
+            backoff, initiator = self._workflow_retry_backoff_seconds(
+                ms, a.get("reason", ""))
+            if backoff < 0:
+                backoff = self._cron_backoff_seconds(ms)
+                initiator = ContinueAsNewInitiator.CronSchedule
+            if backoff >= 0:
+                self._retry_cron_continue(txn, ms, completed_id, a, backoff,
+                                          initiator)
+                return True
             txn.add(EventType.WorkflowExecutionFailed,
                     decision_task_completed_event_id=completed_id, **a)
             return True
@@ -326,6 +367,52 @@ class HistoryEngine:
         else:
             raise InvalidRequestError(f"unknown decision type {dt}")
         return False
+
+    def _cron_backoff_seconds(self, ms: MutableState) -> int:
+        """GetCronBackoffDuration analog: seconds until the next cron run
+        measured from now, or -1 (backoff/cron.go:48)."""
+        from ..utils.backoff import NO_BACKOFF, get_backoff_for_next_schedule
+        info = ms.execution_info
+        if not info.cron_schedule:
+            return NO_BACKOFF
+        return get_backoff_for_next_schedule(
+            info.cron_schedule, info.start_timestamp, self.clock.now())
+
+    def _workflow_retry_backoff_seconds(self, ms: MutableState,
+                                        failure_reason: str):
+        """Workflow-level retry backoff on FailWorkflow (retry.go math over
+        ExecutionInfo's retry fields)."""
+        from ..utils.backoff import NO_BACKOFF, get_backoff_interval
+        info = ms.execution_info
+        if not info.has_retry_policy:
+            return NO_BACKOFF, ContinueAsNewInitiator.RetryPolicy
+        backoff_nanos = get_backoff_interval(
+            now_nanos=self.clock.now(),
+            expiration_time_nanos=info.expiration_time,
+            curr_attempt=info.attempt,
+            max_attempts=info.maximum_attempts,
+            init_interval_seconds=info.initial_interval,
+            max_interval_seconds=info.maximum_interval,
+            backoff_coefficient=info.backoff_coefficient,
+            failure_reason=failure_reason,
+            non_retriable_errors=info.non_retriable_errors,
+        )
+        if backoff_nanos == NO_BACKOFF:
+            return NO_BACKOFF, ContinueAsNewInitiator.RetryPolicy
+        return backoff_nanos // 1_000_000_000, ContinueAsNewInitiator.RetryPolicy
+
+    def _retry_cron_continue(self, txn: "_Txn", ms: MutableState,
+                             completed_id: int, attrs: Dict[str, Any],
+                             backoff_seconds: int,
+                             initiator: ContinueAsNewInitiator) -> None:
+        """retryCronContinueAsNew (task_handler.go:456,:545): chain the next
+        run with the computed backoff and initiator."""
+        chained = dict(attrs)
+        chained["backoff_start_interval_seconds"] = backoff_seconds
+        chained["initiator"] = initiator
+        if initiator == ContinueAsNewInitiator.RetryPolicy:
+            chained["attempt"] = ms.execution_info.attempt + 1
+        self._continue_as_new(txn, ms, completed_id, chained)
 
     def _continue_as_new(self, txn: "_Txn", ms: MutableState,
                          completed_id: int, attrs: Dict[str, Any]) -> None:
@@ -342,6 +429,17 @@ class HistoryEngine:
                              attrs: Dict[str, Any]) -> None:
         info = old_ms.execution_info
         backoff = attrs.get("backoff_start_interval_seconds", 0) or 0
+        retry_policy = attrs.get("retry_policy")
+        if retry_policy is None and info.has_retry_policy:
+            # retry/cron chains keep the original policy
+            retry_policy = RetryPolicy(
+                initial_interval_seconds=info.initial_interval,
+                backoff_coefficient=info.backoff_coefficient,
+                maximum_interval_seconds=info.maximum_interval,
+                maximum_attempts=info.maximum_attempts,
+                expiration_interval_seconds=info.expiration_seconds,
+                non_retriable_error_reasons=list(info.non_retriable_errors),
+            )
         self.start_workflow(
             domain_id=info.domain_id,
             workflow_id=info.workflow_id,
@@ -354,6 +452,11 @@ class HistoryEngine:
                 info.decision_start_to_close_timeout),
             cron_schedule=info.cron_schedule,
             first_decision_backoff=backoff,
+            retry_policy=retry_policy,
+            initiator=attrs.get("initiator"),
+            attempt=attrs.get("attempt", 0) or 0,
+            # a retry chain shares the FIRST run's expiration deadline
+            expiration_timestamp=info.expiration_time,
             request_id=f"can-{new_run_id}",
             # the continued run keeps the workflow ID and MUST use the run ID
             # recorded in the ContinuedAsNew event, or the persisted chain
@@ -377,6 +480,12 @@ class HistoryEngine:
     def record_activity_task_started(self, domain_id: str, workflow_id: str,
                                      run_id: str, schedule_id: int,
                                      request_id: str) -> TaskToken:
+        """AddActivityTaskStartedEvent (mutable_state_builder.go:2218).
+
+        Activities WITH a retry policy start transiently: no started event
+        is written yet (a failure may retry without ever recording it);
+        mutable state alone tracks the attempt, and the started event is
+        flushed when the activity finally closes (:2239-2251)."""
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             raise InvalidRequestError("workflow execution already completed")
@@ -385,6 +494,18 @@ class HistoryEngine:
             raise InvalidRequestError(f"activity {schedule_id} not pending")
         if ai.started_id != EMPTY_EVENT_ID:
             raise InvalidRequestError(f"activity {schedule_id} already started")
+        if ai.has_retry_policy:
+            now = self.clock.now()
+            ai.version = ms.current_version
+            ai.started_id = TRANSIENT_EVENT_ID
+            ai.request_id = request_id
+            ai.started_time = now
+            ai.last_heartbeat_updated_time = now
+            self._commit_transient(ms, expected)
+            return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
+                             run_id=run_id, schedule_id=schedule_id,
+                             started_id=TRANSIENT_EVENT_ID,
+                             attempt=ai.attempt)
         txn = self._new_transaction(ms)
         started = txn.add(EventType.ActivityTaskStarted,
                           scheduled_event_id=schedule_id, request_id=request_id)
@@ -393,17 +514,47 @@ class HistoryEngine:
                          run_id=run_id, schedule_id=schedule_id,
                          started_id=started.id)
 
+    @staticmethod
+    def _flush_transient_started(txn: "_Txn", ms: MutableState,
+                                 schedule_id: int) -> Optional[HistoryEvent]:
+        """addTransientActivityStartedEvent (mutable_state_builder.go:2199):
+        write the deferred started event now that the activity is closing."""
+        ai = ms.pending_activity_info_ids.get(schedule_id)
+        if ai is None or ai.started_id != TRANSIENT_EVENT_ID:
+            return None
+        event = txn.add(EventType.ActivityTaskStarted,
+                        scheduled_event_id=schedule_id,
+                        attempt=ai.attempt, request_id=ai.request_id,
+                        last_failure_reason=ai.last_failure_reason)
+        if ai.started_time != 0:
+            # started event keeps the real start time recorded in the info
+            event.timestamp = ai.started_time
+        return event
+
     def _respond_activity(self, token: TaskToken, close_type: EventType,
-                          **extra: Any) -> None:
+                          try_retry: bool = False, **extra: Any) -> None:
+        """One activity response transaction. With `try_retry`, a failure
+        with remaining retry budget re-attempts transiently (no events);
+        only the final outcome reaches history."""
+        from ..oracle.retry import retry_activity
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             raise InvalidRequestError("workflow execution already completed")
         ai = ms.pending_activity_info_ids.get(token.schedule_id)
-        if ai is None or ai.started_id != token.started_id:
+        if (ai is None or ai.started_id != token.started_id
+                or ai.attempt != token.attempt):
             raise InvalidRequestError("activity task no longer current")
+        if try_retry and retry_activity(ms, ai, self.clock.now(),
+                                        extra.get("reason", "")):
+            self._commit_transient(ms, expected)
+            return
         txn = self._new_transaction(ms)
+        started_id = token.started_id
+        transient = self._flush_transient_started(txn, ms, token.schedule_id)
+        if transient is not None:
+            started_id = transient.id
         txn.add(close_type, scheduled_event_id=token.schedule_id,
-                started_event_id=token.started_id, **extra)
+                started_event_id=started_id, **extra)
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
 
@@ -413,10 +564,26 @@ class HistoryEngine:
 
     def respond_activity_task_failed(self, token: TaskToken,
                                      reason: str = "") -> None:
-        self._respond_activity(token, EventType.ActivityTaskFailed, reason=reason)
+        self._respond_activity(token, EventType.ActivityTaskFailed,
+                               try_retry=True, reason=reason)
 
     def respond_activity_task_canceled(self, token: TaskToken) -> None:
         self._respond_activity(token, EventType.ActivityTaskCanceled)
+
+    def _commit_transient(self, ms: MutableState,
+                          expected_next_event_id: int) -> None:
+        """Persist a mutable-state-only change (no history events): the
+        transient activity start/retry transaction. Runs the timer sequence
+        like every transaction close (CloseTransactionAsMutation)."""
+        from ..oracle import task_generator as taskgen
+        taskgen.generate_activity_timer_tasks(ms)
+        taskgen.generate_user_timer_tasks(ms)
+        info = ms.execution_info
+        transfer, timer = list(ms.transfer_tasks), list(ms.timer_tasks)
+        ms.transfer_tasks, ms.timer_tasks = [], []
+        self.shard.update_workflow(ms, expected_next_event_id)
+        self.shard.insert_tasks(info.domain_id, info.workflow_id,
+                                info.run_id, transfer, timer)
 
     # ------------------------------------------------------------------
     # Signals / cancel / terminate (historyEngine.go:2202,:2629 region)
@@ -471,13 +638,17 @@ class HistoryEngine:
         txn.commit(expected)
 
     def activity_timeout(self, domain_id: str, workflow_id: str, run_id: str,
-                         schedule_id: int, timeout_type: int) -> None:
+                         schedule_id: int, timeout_type: int,
+                         attempt: int = 0) -> None:
+        from ..oracle.retry import retry_activity
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             return
         ai = ms.pending_activity_info_ids.get(schedule_id)
         if ai is None:
             return
+        if ai.attempt != attempt:
+            return  # timer from a superseded attempt is stale
         tt = TimeoutType(timeout_type)
         started = ai.started_id != EMPTY_EVENT_ID
         # validity per timer type (timer_active_task_executor.go)
@@ -485,9 +656,20 @@ class HistoryEngine:
             return
         if tt == TimeoutType.ScheduleToStart and started:
             return  # schedule-to-start no longer applicable once started
+        # started-activity timeouts retry before closing (the timer
+        # executor's RetryActivity call); schedule-to-{start,close} are the
+        # dispatch/overall deadlines and close directly
+        if tt in (TimeoutType.StartToClose, TimeoutType.Heartbeat):
+            if retry_activity(ms, ai, self.clock.now(), f"cadenceInternal:Timeout {tt.name}"):
+                self._commit_transient(ms, expected)
+                return
         txn = self._new_transaction(ms)
+        started_id = ai.started_id
+        transient = self._flush_transient_started(txn, ms, schedule_id)
+        if transient is not None:
+            started_id = transient.id
         txn.add(EventType.ActivityTaskTimedOut, scheduled_event_id=schedule_id,
-                started_event_id=ai.started_id, timeout_type=int(tt))
+                started_event_id=started_id, timeout_type=int(tt))
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
 

@@ -253,7 +253,8 @@ class QueueProcessors:
                                        task.event_id)
             elif tt == TimerTaskType.ActivityTimeout:
                 engine.activity_timeout(domain_id, workflow_id, run_id,
-                                        task.event_id, task.timeout_type)
+                                        task.event_id, task.timeout_type,
+                                        attempt=task.attempt)
             elif tt == TimerTaskType.DecisionTimeout:
                 engine.decision_timeout(domain_id, workflow_id, run_id,
                                         task.event_id, task.timeout_type)
@@ -264,6 +265,21 @@ class QueueProcessors:
             elif tt == TimerTaskType.DeleteHistoryEvent:
                 pass  # retention deletion handled by the scavenger worker
             elif tt == TimerTaskType.ActivityRetryTimer:
-                pass  # activity retry arrives with the retry subsystem
+                self._dispatch_activity_retry(domain_id, workflow_id, run_id,
+                                              task)
         except EntityNotExistsError:
             pass  # workflow already gone — timer is stale
+
+    def _dispatch_activity_retry(self, domain_id: str, workflow_id: str,
+                                 run_id: str, task: GeneratedTask) -> None:
+        """executeActivityRetryTimerTask (timer_active_task_executor.go):
+        the backoff elapsed — re-dispatch the pending attempt straight to
+        matching; no history event is written for a retry dispatch."""
+        from ..core.enums import EMPTY_EVENT_ID
+        ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
+        ai = ms.pending_activity_info_ids.get(task.event_id)
+        if (ai is None or ai.started_id != EMPTY_EVENT_ID
+                or ai.attempt != task.attempt):
+            return  # attempt superseded or already running
+        self.matching.add_activity_task(domain_id, ai.task_list,
+                                        workflow_id, run_id, ai.schedule_id)

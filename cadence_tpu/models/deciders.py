@@ -138,6 +138,55 @@ class ChildWorkflowDecider:
 
 
 @dataclass
+class RetryActivityDecider:
+    """canary retry: one activity carrying a retry policy; complete when it
+    finally succeeds, fail the workflow if it exhausts its attempts."""
+
+    task_list: str
+    initial_interval: int = 1
+    backoff_coefficient: float = 2.0
+    maximum_attempts: int = 3
+
+    def decide(self, history: List[HistoryEvent]) -> List[Decision]:
+        from ..core.events import RetryPolicy
+        if _count(history, EventType.ActivityTaskCompleted) >= 1:
+            return [_complete()]
+        if _count(history, EventType.ActivityTaskFailed,
+                  EventType.ActivityTaskTimedOut) >= 1:
+            return [Decision(DecisionType.FailWorkflowExecution,
+                             dict(reason="activity retries exhausted"))]
+        if _count(history, EventType.ActivityTaskScheduled) >= 1:
+            return []
+        d = _activity("flaky", self.task_list)
+        d.attrs["retry_policy"] = RetryPolicy(
+            initial_interval_seconds=self.initial_interval,
+            backoff_coefficient=self.backoff_coefficient,
+            maximum_interval_seconds=60,
+            maximum_attempts=self.maximum_attempts,
+        )
+        return [d]
+
+
+@dataclass
+class CompleteDecider:
+    """cron body: complete on the first decision (canary cron.go runs)."""
+
+    def decide(self, history: List[HistoryEvent]) -> List[Decision]:
+        return [_complete()]
+
+
+@dataclass
+class FailDecider:
+    """workflow-retry body: fail on the first decision."""
+
+    reason: str = "wf-boom"
+
+    def decide(self, history: List[HistoryEvent]) -> List[Decision]:
+        return [Decision(DecisionType.FailWorkflowExecution,
+                         dict(reason=self.reason))]
+
+
+@dataclass
 class CancellationDecider:
     """canary cancellation: on cancel request, cancel the workflow."""
 

@@ -100,13 +100,14 @@ enum AttrCode : uint8_t {
   kANewRunId = 38,          // string
   kAParentClosePolicy = 39,
   kAChildWfOnly = 40,
-  kMaxAttrCode = 41,
+  kALastFailureReason = 41,  // string
+  kMaxAttrCode = 42,
 };
 
 inline bool IsStringCode(uint8_t code) {
   return code == kAActivityId || code == kATimerId ||
          code == kAParentWorkflowId || code == kAParentRunId ||
-         code == kAParentDomainId ||
+         code == kAParentDomainId || code == kALastFailureReason ||
          (code >= kATaskList && code <= kANewRunId);
 }
 

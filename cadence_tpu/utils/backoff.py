@@ -1,0 +1,181 @@
+"""Retry backoff + cron schedule math.
+
+Reference:
+- activity retry interval: service/history/execution/retry.go:31-80
+  (getBackoffInterval — exponential with cap, total-attempt limit,
+  expiration cut-off, non-retriable reasons);
+- cron continuation:      common/backoff/cron.go:48
+  (GetBackoffForNextSchedule — next standard-cron fire time at or after
+  the close time, measured from the close time, rounded up to seconds).
+
+The cron parser implements standard 5-field cron (minute hour day-of-month
+month day-of-week) with *, */step, ranges, lists — the subset
+robfig/cron.ParseStandard accepts minus macros and time zones.
+"""
+from __future__ import annotations
+
+import math
+from datetime import datetime, timedelta, timezone
+from typing import List, Optional, Sequence
+
+NANOS_PER_SECOND = 1_000_000_000
+
+#: sentinel: no retry / no next cron run (backoff.NoBackoff)
+NO_BACKOFF = -1
+
+
+def get_backoff_interval(now_nanos: int, expiration_time_nanos: int,
+                         curr_attempt: int, max_attempts: int,
+                         init_interval_seconds: int,
+                         max_interval_seconds: int,
+                         backoff_coefficient: float,
+                         failure_reason: str,
+                         non_retriable_errors: Sequence[str]
+                         ) -> int:
+    """Next retry interval in NANOS, or NO_BACKOFF (retry.go:31-80)."""
+    if max_attempts == 0 and expiration_time_nanos == 0:
+        return NO_BACKOFF
+    if max_attempts > 0 and curr_attempt >= max_attempts - 1:
+        # currAttempt starts from 0; MaximumAttempts counts the initial try
+        return NO_BACKOFF
+
+    try:
+        next_interval = int(float(init_interval_seconds)
+                            * math.pow(backoff_coefficient, float(curr_attempt)))
+    except OverflowError:
+        next_interval = 0
+    if next_interval <= 0:
+        # math.Pow() could overflow
+        if max_interval_seconds > 0:
+            next_interval = max_interval_seconds
+        else:
+            return NO_BACKOFF
+    if max_interval_seconds > 0 and next_interval > max_interval_seconds:
+        next_interval = max_interval_seconds
+
+    backoff_nanos = next_interval * NANOS_PER_SECOND
+    if expiration_time_nanos != 0 and now_nanos + backoff_nanos > expiration_time_nanos:
+        return NO_BACKOFF
+    if failure_reason in non_retriable_errors:
+        return NO_BACKOFF
+    return backoff_nanos
+
+
+# ---------------------------------------------------------------------------
+# Standard cron (minute-granularity), cron.go:48 semantics
+# ---------------------------------------------------------------------------
+
+
+class CronField:
+    """One parsed cron field: the set of allowed values."""
+
+    __slots__ = ("allowed",)
+
+    def __init__(self, spec: str, lo: int, hi: int) -> None:
+        allowed = set()
+        for part in spec.split(","):
+            step = 1
+            has_step = False
+            if "/" in part:
+                part, step_s = part.split("/", 1)
+                step = int(step_s)
+                has_step = True
+                if step <= 0:
+                    raise ValueError(f"bad cron step {step_s}")
+            if part == "*" or part == "?":
+                lo_p, hi_p = lo, hi
+            elif "-" in part:
+                a, b = part.split("-", 1)
+                lo_p, hi_p = int(a), int(b)
+            else:
+                lo_p = int(part)
+                # "N/step" means from N to the field maximum by step
+                hi_p = hi if has_step else lo_p
+            if lo_p < lo or hi_p > hi or lo_p > hi_p:
+                raise ValueError(f"cron value out of range: {part} not in [{lo},{hi}]")
+            allowed.update(range(lo_p, hi_p + 1, step))
+        self.allowed = frozenset(allowed)
+
+    def match(self, value: int) -> bool:
+        return value in self.allowed
+
+
+class CronSchedule:
+    """Parsed 5-field standard cron expression."""
+
+    def __init__(self, spec: str) -> None:
+        fields = spec.split()
+        if len(fields) != 5:
+            raise ValueError(f"cron spec needs 5 fields, got {len(fields)}: {spec!r}")
+        self.minute = CronField(fields[0], 0, 59)
+        self.hour = CronField(fields[1], 0, 23)
+        self.dom = CronField(fields[2], 1, 31)
+        self.month = CronField(fields[3], 1, 12)
+        # cron day-of-week: 0-6, 0 == Sunday (7 accepted as a Sunday alias)
+        self.dow = CronField(fields[4], 0, 7)
+        #: dom/dow OR-semantics apply when both are restricted (std cron)
+        self.dom_star = fields[2] in ("*", "?")
+        self.dow_star = fields[4] in ("*", "?")
+
+    def _day_match(self, t: datetime) -> bool:
+        dom_ok = self.dom.match(t.day)
+        cron_dow = (t.weekday() + 1) % 7  # python Mon=0 → cron Sun=0
+        dow_ok = self.dow.match(cron_dow) or (cron_dow == 0 and self.dow.match(7))
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok
+
+    def next_after(self, t: datetime) -> Optional[datetime]:
+        """Earliest fire time strictly after t (cron.Schedule.Next)."""
+        cur = (t.replace(second=0, microsecond=0) + timedelta(minutes=1))
+        limit = t + timedelta(days=4 * 366)  # robfig's ~4-year give-up bound
+        while cur <= limit:
+            if not self.month.match(cur.month):
+                cur = (cur.replace(day=1, hour=0, minute=0)
+                       + timedelta(days=32)).replace(day=1)
+                continue
+            if not self._day_match(cur):
+                cur = cur.replace(hour=0, minute=0) + timedelta(days=1)
+                continue
+            if not self.hour.match(cur.hour):
+                cur = cur.replace(minute=0) + timedelta(hours=1)
+                continue
+            if not self.minute.match(cur.minute):
+                cur += timedelta(minutes=1)
+                continue
+            return cur
+        return None
+
+
+def validate_cron_schedule(spec: str) -> bool:
+    """ValidateSchedule analog (cron.go:37): empty means "no cron"."""
+    if spec == "":
+        return True
+    try:
+        CronSchedule(spec)
+        return True
+    except (ValueError, IndexError):
+        return False
+
+
+def get_backoff_for_next_schedule(cron_schedule: str, start_nanos: int,
+                                  close_nanos: int) -> int:
+    """Seconds until the next cron run measured from close time, or
+    NO_BACKOFF (cron.go:48 GetBackoffForNextScheduleInSeconds)."""
+    if not cron_schedule:
+        return NO_BACKOFF
+    try:
+        schedule = CronSchedule(cron_schedule)
+    except (ValueError, IndexError):
+        return NO_BACKOFF
+    start = datetime.fromtimestamp(start_nanos / NANOS_PER_SECOND, tz=timezone.utc)
+    close = datetime.fromtimestamp(close_nanos / NANOS_PER_SECOND, tz=timezone.utc)
+    nxt = schedule.next_after(start)
+    while nxt is not None and nxt < close:
+        nxt = schedule.next_after(nxt)
+    if nxt is None:
+        return NO_BACKOFF
+    backoff_seconds = (nxt - close).total_seconds()
+    return int(math.ceil(backoff_seconds))

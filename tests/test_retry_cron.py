@@ -1,0 +1,266 @@
+"""Activity retry + cron continuation tests.
+
+Reference tier: host/retry_policy_workflow_test.go + canary retry/cron
+(canary/retry.go, canary/cron.go); backoff math per
+service/history/execution/retry.go:31-80 and common/backoff/cron.go:48.
+"""
+import pytest
+
+from cadence_tpu.core.enums import (
+    EMPTY_EVENT_ID,
+    TRANSIENT_EVENT_ID,
+    CloseStatus,
+    ContinueAsNewInitiator,
+    EventType,
+)
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import (
+    CompleteDecider,
+    FailDecider,
+    RetryActivityDecider,
+)
+from cadence_tpu.utils.backoff import (
+    NO_BACKOFF,
+    get_backoff_for_next_schedule,
+    get_backoff_interval,
+)
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "retry-domain"
+TL = "retry-tl"
+SECOND = 1_000_000_000
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _schedule_flaky_activity(box, poller, workflow_id):
+    """Start + first decision: one activity with a retry policy lands in
+    matching."""
+    box.pump_once()
+    assert poller.poll_and_decide_once()
+    box.pump_once()
+
+
+class TestActivityRetry:
+    def test_fails_twice_then_succeeds(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "flaky-1", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"flaky-1": RetryActivityDecider(TL)})
+        _schedule_flaky_activity(box, poller, "flaky-1")
+
+        for attempt in range(2):
+            resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+            assert resp is not None
+            assert resp.token.started_id == TRANSIENT_EVENT_ID
+            box.frontend.respond_activity_task_failed(resp.token, "boom")
+            # transient retry: nothing new in history
+            events = box.frontend.get_workflow_execution_history(DOMAIN, "flaky-1")
+            assert not any(e.event_type in (EventType.ActivityTaskStarted,
+                                            EventType.ActivityTaskFailed)
+                           for e in events)
+            # backoff 1s then 2s; advance past it and fire the retry timer
+            box.advance_time(4)
+            box.pump_once()
+
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        assert resp is not None
+        box.frontend.respond_activity_task_completed(resp.token)
+        poller.drain()
+
+        ms = box.frontend.describe_workflow_execution(DOMAIN, "flaky-1")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "flaky-1")
+        started = [e for e in events
+                   if e.event_type == EventType.ActivityTaskStarted]
+        scheduled = [e for e in events
+                     if e.event_type == EventType.ActivityTaskScheduled]
+        # ONE scheduled event, ONE flushed started event carrying the final
+        # attempt count and the last failure (transient retry semantics)
+        assert len(scheduled) == 1 and len(started) == 1
+        assert started[0].get("attempt") == 2
+        assert started[0].get("last_failure_reason") == "boom"
+
+    def test_retries_exhausted_fails_workflow(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "flaky-2", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"flaky-2": RetryActivityDecider(
+                                TL, maximum_attempts=2)})
+        _schedule_flaky_activity(box, poller, "flaky-2")
+
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_failed(resp.token, "boom")  # retries
+        box.advance_time(2)
+        box.pump_once()
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_failed(resp.token, "boom")  # final
+        poller.drain()
+
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "flaky-2")
+        failed = [e for e in events
+                  if e.event_type == EventType.ActivityTaskFailed]
+        started = [e for e in events
+                   if e.event_type == EventType.ActivityTaskStarted]
+        assert len(failed) == 1 and len(started) == 1
+        assert started[0].get("attempt") == 1
+        ms = box.frontend.describe_workflow_execution(DOMAIN, "flaky-2")
+        assert ms.execution_info.close_status == CloseStatus.Failed
+
+    def test_stale_attempt_token_rejected(self, box):
+        """A superseded attempt's token must not close the current attempt:
+        transient attempts share started_id, so the token's attempt field
+        is the disambiguator (reference taskToken.ScheduleAttempt)."""
+        from cadence_tpu.engine.history_engine import InvalidRequestError
+        box.frontend.start_workflow_execution(DOMAIN, "flaky-s", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"flaky-s": RetryActivityDecider(TL)})
+        _schedule_flaky_activity(box, poller, "flaky-s")
+        stale = box.frontend.poll_for_activity_task(DOMAIN, TL).token
+        box.frontend.respond_activity_task_failed(stale, "boom")  # → attempt 1
+        box.advance_time(2)
+        box.pump_once()
+        fresh = box.frontend.poll_for_activity_task(DOMAIN, TL).token
+        assert fresh.attempt == 1
+        with pytest.raises(InvalidRequestError):
+            box.frontend.respond_activity_task_completed(stale)
+        box.frontend.respond_activity_task_completed(fresh)
+        poller.drain()
+        ms = box.frontend.describe_workflow_execution(DOMAIN, "flaky-s")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_retry_history_replays_on_device(self, box):
+        """Kernel/oracle parity on an ENGINE-generated retry-shaped history
+        (the corpus no longer needs to fake these)."""
+        box.frontend.start_workflow_execution(DOMAIN, "flaky-3", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"flaky-3": RetryActivityDecider(TL)})
+        _schedule_flaky_activity(box, poller, "flaky-3")
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_failed(resp.token, "boom")
+        box.advance_time(2)
+        box.pump_once()
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_completed(resp.token)
+        poller.drain()
+
+        result = box.tpu.verify_all()
+        assert result.ok and result.total >= 1
+
+
+class TestCron:
+    def test_cron_reruns_on_schedule(self, box):
+        box.frontend.start_workflow_execution(
+            DOMAIN, "cron-1", "cron-type", TL, cron_schedule="* * * * *")
+        poller = TaskPoller(box, DOMAIN, TL, {"cron-1": CompleteDecider()})
+        poller.drain()
+
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run1 = None
+        # first run closed as continued-as-new, not completed
+        runs = [k for k in box.stores.execution.list_executions()
+                if k[1] == "cron-1"]
+        assert len(runs) == 2
+        states = {k[2]: box.stores.execution.get_workflow(*k) for k in runs}
+        closed = [ms for ms in states.values()
+                  if ms.execution_info.close_status == CloseStatus.ContinuedAsNew]
+        assert len(closed) == 1
+        events = box.stores.history.read_events(*[
+            k for k in runs
+            if states[k[2]].execution_info.close_status == CloseStatus.ContinuedAsNew
+        ][0])
+        can = [e for e in events
+               if e.event_type == EventType.WorkflowExecutionContinuedAsNew]
+        assert len(can) == 1
+
+        # second run waits on its cron backoff timer; fire it
+        box.advance_time(61)
+        box.pump_once()
+        poller.drain()
+        runs = [k for k in box.stores.execution.list_executions()
+                if k[1] == "cron-1"]
+        assert len(runs) == 3  # second completion chained a third run
+
+    def test_cron_second_run_carries_initiator(self, box):
+        box.frontend.start_workflow_execution(
+            DOMAIN, "cron-2", "cron-type", TL, cron_schedule="* * * * *")
+        poller = TaskPoller(box, DOMAIN, TL, {"cron-2": CompleteDecider()})
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        current = box.stores.execution.get_current_run_id(domain_id, "cron-2")
+        start = box.stores.history.read_events(domain_id, "cron-2", current)[0]
+        assert start.get("initiator") == ContinueAsNewInitiator.CronSchedule
+        assert (start.get("first_decision_task_backoff_seconds") or 0) > 0
+
+
+class TestWorkflowRetry:
+    def test_failing_workflow_retries_then_gives_up(self, box):
+        from cadence_tpu.core.events import RetryPolicy
+        box.frontend.start_workflow_execution(
+            DOMAIN, "wfr-1", "fail-type", TL,
+            retry_policy=RetryPolicy(initial_interval_seconds=1,
+                                     backoff_coefficient=2.0,
+                                     maximum_interval_seconds=10,
+                                     maximum_attempts=2))
+        poller = TaskPoller(box, DOMAIN, TL, {"wfr-1": FailDecider()})
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run1_keys = [k for k in box.stores.execution.list_executions()
+                     if k[1] == "wfr-1"]
+        assert len(run1_keys) == 2  # original + retry run
+        current = box.stores.execution.get_current_run_id(domain_id, "wfr-1")
+        start = box.stores.history.read_events(domain_id, "wfr-1", current)[0]
+        assert start.get("initiator") == ContinueAsNewInitiator.RetryPolicy
+        assert start.get("attempt") == 1
+
+        # retry run waits on its backoff timer, then fails for real
+        box.advance_time(2)
+        box.pump_once()
+        poller.drain()
+        ms = box.frontend.describe_workflow_execution(DOMAIN, "wfr-1")
+        assert ms.execution_info.close_status == CloseStatus.Failed
+        assert len([k for k in box.stores.execution.list_executions()
+                    if k[1] == "wfr-1"]) == 2  # attempts exhausted, no 3rd run
+
+
+class TestBackoffMath:
+    def test_exponential_with_cap(self):
+        # attempt 0: 2s; attempt 3: 2*3^3=54 → capped at 30
+        assert get_backoff_interval(0, 0, 0, 10, 2, 30, 3.0, "", []) == 2 * SECOND
+        assert get_backoff_interval(0, 0, 3, 10, 2, 30, 3.0, "", []) == 30 * SECOND
+
+    def test_max_attempts_counts_initial(self):
+        # maxAttempts=3 allows attempts 0,1,2; currAttempt 2 → no backoff
+        assert get_backoff_interval(0, 0, 2, 3, 1, 0, 2.0, "", []) == NO_BACKOFF
+        assert get_backoff_interval(0, 0, 1, 3, 1, 0, 2.0, "", []) == 2 * SECOND
+
+    def test_expiration_cuts_off(self):
+        now = 100 * SECOND
+        assert get_backoff_interval(now, now + 1 * SECOND, 0, 10, 5, 0,
+                                    1.0, "", []) == NO_BACKOFF
+
+    def test_non_retriable_reason(self):
+        assert get_backoff_interval(0, 0, 0, 10, 1, 0, 2.0,
+                                    "bad", ["bad"]) == NO_BACKOFF
+
+    def test_no_policy_means_no_backoff(self):
+        assert get_backoff_interval(0, 0, 0, 0, 1, 0, 2.0, "", []) == NO_BACKOFF
+
+    def test_cron_every_minute(self):
+        # close at t=90s → next minute boundary 120s → 30s backoff
+        assert get_backoff_for_next_schedule("* * * * *", 0, 90 * SECOND) == 30
+
+    def test_cron_every_five_minutes(self):
+        assert get_backoff_for_next_schedule("*/5 * * * *", 0, 90 * SECOND) == 210
+
+    def test_cron_hourly_at_minute(self):
+        # "15 * * * *": close at 10:20 → next 11:15 → 3300s
+        close = (10 * 3600 + 20 * 60) * SECOND
+        assert get_backoff_for_next_schedule("15 * * * *", 0, close) == 3300
+
+    def test_invalid_cron(self):
+        assert get_backoff_for_next_schedule("bogus", 0, 0) == NO_BACKOFF
+        assert get_backoff_for_next_schedule("", 0, 0) == NO_BACKOFF
