@@ -22,31 +22,21 @@ from .replication import (
 
 
 def _refresh_domain_tasks(box: Onebox, domain_name: str) -> None:
-    """Promotion sweep: regenerate tasks for every CURRENT run of the
-    domain. Zombie runs (persisted but not holding the current-run pointer
-    after NDC arbitration) are skipped — refreshing them would dispatch
-    work for a run that lost, executing the same workflow twice."""
-    from .persistence import EntityNotExistsError
+    """Promotion sweep for one domain (shared sweep in task_refresher)."""
+    from .task_refresher import sweep_refresh
     domain_id = box.stores.domain.by_name(domain_name).domain_id
-    for d_id, wf_id, run_id in \
-            box.stores.execution.list_domain_executions(domain_id):
-        try:
-            current = box.stores.execution.get_current_run_id(d_id, wf_id)
-        except EntityNotExistsError:
-            continue
-        if current != run_id:
-            continue  # zombie run
-        box.route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+    sweep_refresh(box.stores, box.route, domain_id)
 
 
 class ReplicatedClusters:
     def __init__(self, num_hosts: int = 1, num_shards: int = 4,
-                 metadata: Optional[ClusterMetadata] = None) -> None:
+                 metadata: Optional[ClusterMetadata] = None,
+                 active_stores=None, standby_stores=None) -> None:
         self.meta = metadata or ClusterMetadata()
         self.active = Onebox(num_hosts=num_hosts, num_shards=num_shards,
-                             cluster_name="primary")
+                             cluster_name="primary", stores=active_stores)
         self.standby = Onebox(num_hosts=num_hosts, num_shards=num_shards,
-                              cluster_name="standby")
+                              cluster_name="standby", stores=standby_stores)
         self.publisher = ReplicationPublisher(self.active.stores)
         self.active.set_replication_publisher(self.publisher)
         self.replicator = HistoryReplicator(self.standby.stores)

@@ -26,8 +26,11 @@ NANOS = 1_000_000_000
 
 class Onebox:
     def __init__(self, num_hosts: int = 2, num_shards: int = 8,
-                 cluster_name: str = "primary") -> None:
-        self.stores = Stores()
+                 cluster_name: str = "primary",
+                 stores: Optional[Stores] = None) -> None:
+        #: injected stores = durable bundle (crash recovery) or a shared
+        #: bundle; default = fresh in-memory cluster
+        self.stores = stores if stores is not None else Stores()
         self.clock = ManualTimeSource()
         self.cluster_name = cluster_name
         self.num_shards = num_shards
@@ -111,3 +114,12 @@ class Onebox:
 
     def advance_time(self, seconds: float) -> None:
         self.clock.advance(int(seconds * NANOS))
+
+    # -- recovery ----------------------------------------------------------
+
+    def refresh_all_tasks(self) -> int:
+        """Post-recovery sweep: regenerate outstanding tasks for every
+        current run (the shard task queues and matching backlog are not
+        durable — rebuilt state is). Returns tasks created."""
+        from .task_refresher import sweep_refresh
+        return sweep_refresh(self.stores, self.route)

@@ -16,8 +16,12 @@ nosql/sql backends) reduced to its semantic contract:
   (historyManager.go tree/branch model; single branch here — the NDC
   branch tree arrives with the replication layer).
 
-The in-memory backend is the reference's "nosql plugin" seat; the on-disk
-JSONL backend (FileHistoryStore) is for durability tests and bench corpora.
+Durability (round 2): every store accepts an optional write-ahead log
+(engine/durability.py DurableLog). Mutations append one JSONL record;
+recovery replays the log into fresh stores and REBUILDS mutable states
+from history (event sourcing — the snapshot store is derivable), with the
+TPU replay engine bulk-verifying the rebuilt states (the reference's
+recovery path is stateRebuilder per workflow, state_rebuilder.go:102).
 All stores are thread-safe.
 """
 from __future__ import annotations
@@ -66,6 +70,7 @@ class ShardStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._shards: Dict[int, ShardInfo] = {}
+        self._wal = None
 
     def get_or_create(self, shard_id: int) -> ShardInfo:
         with self._lock:
@@ -84,6 +89,14 @@ class ShardStore:
                     f"shard {info.shard_id}: expected range {expected_range_id}, "
                     f"have {cur.range_id if cur else None}"
                 )
+            self._shards[info.shard_id] = ShardInfo(**vars(info))
+            if self._wal is not None:
+                from .durability import shard_record
+                self._wal.append(shard_record(info))
+
+    def restore(self, info: ShardInfo) -> None:
+        """Recovery: install a shard record without fencing checks."""
+        with self._lock:
             self._shards[info.shard_id] = ShardInfo(**vars(info))
 
 
@@ -110,6 +123,7 @@ class HistoryStore:
         #: list of event batches
         self._branches: Dict[Tuple[str, str, str], List[List[List[HistoryEvent]]]] = {}
         self._current: Dict[Tuple[str, str, str], int] = {}
+        self._wal = None
 
     def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
                      events: List[HistoryEvent],
@@ -131,6 +145,10 @@ class HistoryStore:
                         f"{events[0].id}, expected {expected}"
                     )
             target.append(list(events))
+            if self._wal is not None:
+                from .durability import history_record
+                self._wal.append(history_record(domain_id, workflow_id,
+                                                run_id, index, events))
 
     def fork_branch(self, domain_id: str, workflow_id: str, run_id: str,
                     source_branch: int, fork_event_id: int) -> int:
@@ -151,17 +169,34 @@ class HistoryStore:
                         forked.append(partial)
                     break
             branches.append(forked)
+            if self._wal is not None:
+                from .durability import fork_record
+                self._wal.append(fork_record(domain_id, workflow_id, run_id,
+                                             source_branch, fork_event_id))
             return len(branches) - 1
 
     def set_current_branch(self, domain_id: str, workflow_id: str,
                            run_id: str, branch: int) -> None:
         with self._lock:
             self._current[(domain_id, workflow_id, run_id)] = branch
+            if self._wal is not None:
+                from .durability import current_branch_record
+                self._wal.append(current_branch_record(
+                    domain_id, workflow_id, run_id, branch))
 
     def get_current_branch(self, domain_id: str, workflow_id: str,
                            run_id: str) -> int:
         with self._lock:
             return self._current.get((domain_id, workflow_id, run_id), 0)
+
+    def list_runs(self) -> List[Tuple[str, str, str]]:
+        with self._lock:
+            return list(self._branches.keys())
+
+    def branch_count(self, domain_id: str, workflow_id: str, run_id: str) -> int:
+        with self._lock:
+            branches = self._branches.get((domain_id, workflow_id, run_id))
+            return 0 if branches is None else len(branches)
 
     def read_batches(self, domain_id: str, workflow_id: str, run_id: str,
                      branch: Optional[int] = None) -> List[List[HistoryEvent]]:
@@ -209,6 +244,7 @@ class ExecutionStore:
 
     def __init__(self, shard_store: ShardStore) -> None:
         self._lock = threading.Lock()
+        self._wal = None
         self._shard_store = shard_store
         #: (domain_id, workflow_id, run_id) -> (MutableState, checksum value)
         self._executions: Dict[Tuple[str, str, str], MutableState] = {}
@@ -240,6 +276,7 @@ class ExecutionStore:
             self._current[cur_key] = CurrentExecution(
                 run_id=info.run_id, state=info.state, close_status=info.close_status
             )
+            self._log_current(cur_key)
 
     def update_workflow(self, shard_id: int, range_id: int, ms: MutableState,
                         expected_next_event_id: int) -> None:
@@ -266,6 +303,7 @@ class ExecutionStore:
                     run_id=info.run_id, state=info.state,
                     close_status=info.close_status,
                 )
+                self._log_current(cur_key)
 
     def upsert_workflow(self, ms: MutableState, set_current: bool = True) -> None:
         """UpdateWorkflowExecutionAsPassive analog: unconditional snapshot
@@ -282,6 +320,29 @@ class ExecutionStore:
                     run_id=info.run_id, state=info.state,
                     close_status=info.close_status,
                 )
+                self._log_current((info.domain_id, info.workflow_id))
+
+    def _log_current(self, cur_key) -> None:
+        if self._wal is not None:
+            from .durability import current_run_record
+            self._wal.append(current_run_record(
+                cur_key[0], cur_key[1], self._current[cur_key]))
+
+    def restore_current(self, domain_id: str, workflow_id: str,
+                        cur: CurrentExecution) -> None:
+        """Recovery: install a current-run pointer directly."""
+        with self._lock:
+            self._current[(domain_id, workflow_id)] = cur
+
+    def drop_current(self, domain_id: str, workflow_id: str) -> None:
+        """Recovery: remove a pointer whose run has no history (torn
+        start); the workflow id becomes startable again."""
+        with self._lock:
+            self._current.pop((domain_id, workflow_id), None)
+
+    def list_current_pointers(self):
+        with self._lock:
+            return list(self._current.items())
 
     def get_workflow(self, domain_id: str, workflow_id: str, run_id: str
                      ) -> MutableState:
@@ -301,13 +362,6 @@ class ExecutionStore:
     def list_executions(self) -> List[Tuple[str, str, str]]:
         with self._lock:
             return list(self._executions.keys())
-
-    def list_domain_executions(self, domain_id: str) -> List[Tuple[str, str, str]]:
-        """All runs of one domain — the task-refresh sweep on failover
-        promotion iterates these (completed runs too: their close fan-out /
-        retention timer may not have run on this cluster yet)."""
-        with self._lock:
-            return [key for key in self._executions if key[0] == domain_id]
 
 
 # ---------------------------------------------------------------------------
@@ -400,8 +454,14 @@ class DomainInfo:
 class DomainStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._wal = None
         self._by_id: Dict[str, DomainInfo] = {}
         self._by_name: Dict[str, str] = {}
+
+    def _log(self, info: "DomainInfo") -> None:
+        if self._wal is not None:
+            from .durability import domain_record
+            self._wal.append(domain_record(info))
 
     def register(self, info: DomainInfo) -> None:
         with self._lock:
@@ -409,6 +469,7 @@ class DomainStore:
                 raise WorkflowAlreadyStartedError(f"domain {info.name} exists")
             self._by_id[info.domain_id] = info
             self._by_name[info.name] = info.domain_id
+            self._log(info)
 
     def by_name(self, name: str) -> DomainInfo:
         with self._lock:
@@ -427,6 +488,7 @@ class DomainStore:
     def update(self, info: DomainInfo) -> None:
         with self._lock:
             self._by_id[info.domain_id] = info
+            self._log(info)
 
     def list_domains(self) -> List[DomainInfo]:
         with self._lock:
@@ -485,12 +547,16 @@ class VisibilityStore:
 class QueueStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._wal = None
         self._queues: Dict[str, List[object]] = {}
 
     def enqueue(self, queue: str, payload: object) -> int:
         with self._lock:
             q = self._queues.setdefault(queue, [])
             q.append(payload)
+            if self._wal is not None:
+                from .durability import queue_record
+                self._wal.append(queue_record(queue, payload))
             return len(q) - 1
 
     def read(self, queue: str, from_index: int, count: int = 100
@@ -564,3 +630,19 @@ class Stores:
     def __post_init__(self) -> None:
         if self.execution is None:
             self.execution = ExecutionStore(self.shard)
+
+    def attach_wal(self, wal) -> None:
+        """Route every durable mutation through one write-ahead log
+        (matching + shard task queues are rebuilt by the task refresher on
+        recovery and stay memory-only — see engine/durability.py).
+
+        Log appends run INSIDE each store's lock on purpose: recovery
+        replays records in file order and the history/queue replay relies
+        on per-branch contiguity, so the log order must equal mutation
+        order. The cost under the lock is a buffered write + flush (no
+        fsync by default); moving it outside would require per-run
+        sequence numbers to make replay order-insensitive."""
+        self.wal = wal
+        for store in (self.shard, self.history, self.domain, self.queue,
+                      self.execution):
+            store._wal = wal

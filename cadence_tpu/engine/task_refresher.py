@@ -29,6 +29,28 @@ from ..oracle import task_generator as taskgen
 from ..oracle.mutable_state import GeneratedTask, MutableState, seconds_to_nanos
 
 
+def sweep_refresh(stores, route, domain_id: str = None) -> int:
+    """Refresh every CURRENT run (one domain, or all when domain_id is
+    None): the promotion sweep after failover and the post-recovery sweep
+    share this. Completed runs are included — their close fan-out /
+    retention timer may not have run on this cluster yet. Zombie runs
+    (not holding the current-run pointer after NDC arbitration) are
+    skipped: refreshing them would execute a losing run. Returns the
+    number of tasks created."""
+    from .persistence import EntityNotExistsError
+    created = 0
+    for d_id, wf_id, run_id in stores.execution.list_executions():
+        if domain_id is not None and d_id != domain_id:
+            continue
+        try:
+            if stores.execution.get_current_run_id(d_id, wf_id) != run_id:
+                continue
+        except EntityNotExistsError:
+            continue
+        created += route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+    return created
+
+
 def refresh_tasks(ms: MutableState, events_by_id: Dict[int, HistoryEvent]) -> None:
     """Recompute every outstanding task from mutable state
     (mutable_state_task_refresher.go:77 RefreshTasks).

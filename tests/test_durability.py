@@ -1,0 +1,247 @@
+"""Durable persistence + crash recovery tests.
+
+Reference tier: the persistence-conformance suite
+(common/persistence/persistence-tests) + DR rehydration; recovery rebuilds
+mutable state by replay (state_rebuilder.go:102) with the TPU engine as the
+bulk verifier — VERDICT round-1 item 5's kill-restart scenario."""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, EventType
+from cadence_tpu.engine.durability import (
+    open_durable_stores,
+    recover_stores,
+)
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import EchoDecider, RetryActivityDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "durable-domain"
+TL = "durable-tl"
+
+
+class TestKillRestart:
+    def test_100_workflows_survive_crash_and_complete(self, tmp_path):
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=4,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        ids = [f"dur-{i}" for i in range(100)]
+        deciders = {wid: EchoDecider(TL) for wid in ids}
+        for wid in ids:
+            box.frontend.start_workflow_execution(DOMAIN, wid, "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, deciders)
+        # drive halfway: first decisions run, activities dispatched, NOT run
+        box.pump_once()
+        while poller.poll_and_decide_once():
+            pass
+        box.pump_once()
+
+        del box  # CRASH: process dies; matching backlog + queues are gone
+
+        stores, report = recover_stores(wal)
+        assert report.executions_rebuilt == 100
+        assert report.open_workflows == 100
+        assert report.ok, f"divergent after recovery: {report.divergent}"
+        assert report.device_verified + report.oracle_fallback == 100
+
+        box2 = Onebox(num_hosts=1, num_shards=4, stores=stores)
+        assert box2.refresh_all_tasks() > 0
+        poller2 = TaskPoller(box2, DOMAIN, TL, deciders)
+        poller2.drain()
+        for wid in ids:
+            ms = box2.frontend.describe_workflow_execution(DOMAIN, wid)
+            assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_completed_workflows_stay_completed(self, tmp_path):
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "done-1", "echo", TL)
+        TaskPoller(box, DOMAIN, TL, {"done-1": EchoDecider(TL)}).drain()
+        del box
+
+        stores, report = recover_stores(wal)
+        assert report.executions_rebuilt == 1 and report.open_workflows == 0
+        box2 = Onebox(num_hosts=1, num_shards=2, stores=stores)
+        ms = box2.frontend.describe_workflow_execution(DOMAIN, "done-1")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        # recovered history is byte-for-byte usable: same event sequence
+        events = box2.frontend.get_workflow_execution_history(DOMAIN, "done-1")
+        assert events[0].event_type == EventType.WorkflowExecutionStarted
+        assert events[-1].event_type == EventType.WorkflowExecutionCompleted
+
+    def test_second_crash_after_recovery(self, tmp_path):
+        """The recovered process keeps logging to the same WAL; a second
+        crash recovers the post-recovery work too."""
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "w2", "echo", TL)
+        box.pump_once()
+        del box
+
+        stores, _ = recover_stores(wal)
+        box2 = Onebox(num_hosts=1, num_shards=2, stores=stores)
+        box2.refresh_all_tasks()
+        TaskPoller(box2, DOMAIN, TL, {"w2": EchoDecider(TL)}).drain()
+        ms = box2.frontend.describe_workflow_execution(DOMAIN, "w2")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        del box2
+
+        stores3, report3 = recover_stores(wal)
+        box3 = Onebox(num_hosts=1, num_shards=2, stores=stores3)
+        ms = box3.frontend.describe_workflow_execution(DOMAIN, "w2")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert report3.ok
+
+    def test_midretry_activity_restarts_from_attempt_zero(self, tmp_path):
+        """Documented deviation: transient retry state (no events) is not
+        durable — after a crash the activity re-runs from attempt 0; the
+        workflow still completes (at-least-once preserved)."""
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "flaky", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"flaky": RetryActivityDecider(TL)})
+        box.pump_once()
+        poller.poll_and_decide_once()
+        box.pump_once()
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_failed(resp.token, "boom")  # attempt→1
+        del box
+
+        stores, report = recover_stores(wal)
+        assert report.ok
+        box2 = Onebox(num_hosts=1, num_shards=2, stores=stores)
+        domain_id = box2.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box2.stores.execution.get_current_run_id(domain_id, "flaky")
+        ms = box2.stores.execution.get_workflow(domain_id, "flaky", run_id)
+        ai = next(iter(ms.pending_activity_info_ids.values()))
+        assert ai.attempt == 0  # transient attempts reset by design
+        box2.refresh_all_tasks()
+        box2.pump_once()
+        poller2 = TaskPoller(box2, DOMAIN, TL,
+                             {"flaky": RetryActivityDecider(TL)})
+        resp = box2.frontend.poll_for_activity_task(DOMAIN, TL)
+        box2.frontend.respond_activity_task_completed(resp.token)
+        poller2.drain()
+        ms = box2.frontend.describe_workflow_execution(DOMAIN, "flaky")
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+
+class TestTornWrites:
+    def test_torn_trailing_record_is_dropped(self, tmp_path):
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "torn", "echo", TL)
+        TaskPoller(box, DOMAIN, TL, {"torn": EchoDecider(TL)}).drain()
+        del box
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write('{"t":"h","d":"x"')  # kill mid-append
+        stores, report = recover_stores(wal)
+        assert report.ok and report.executions_rebuilt == 1
+
+    def test_mid_file_corruption_refuses_recovery(self, tmp_path):
+        from cadence_tpu.engine.durability import CorruptLogError
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "c", "echo", TL)
+        del box
+        lines = open(wal).read().splitlines()
+        lines[1] = lines[1][:10]  # corrupt a non-final record
+        open(wal, "w").write("\n".join(lines) + "\n")
+        with pytest.raises(CorruptLogError):
+            recover_stores(wal)
+
+    def test_pointer_without_history_is_dropped(self, tmp_path):
+        """Torn start (pointer logged, history batch lost): the workflow id
+        must become startable again, not wedge WorkflowAlreadyStarted."""
+        import json
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        del box
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"t": "cur", "d": "some-domain",
+                                 "w": "ghost", "r": "run-1",
+                                 "st": 1, "cs": 0}) + "\n")
+        stores, report = recover_stores(wal)
+        from cadence_tpu.engine.persistence import EntityNotExistsError
+        with pytest.raises(EntityNotExistsError):
+            stores.execution.get_current_run_id("some-domain", "ghost")
+
+
+class TestNDCDurability:
+    def test_forked_branches_survive_crash(self, tmp_path):
+        """Split-brain divergence on a durable standby: branches, the
+        current pointer, and the conflict-resolved state all recover."""
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+        from cadence_tpu.models.deciders import SignalDecider
+
+        wal = str(tmp_path / "standby.jsonl")
+        c = ReplicatedClusters(num_hosts=1, num_shards=4,
+                               standby_stores=open_durable_stores(wal))
+        c.register_global_domain(DOMAIN)
+        c.active.frontend.start_workflow_execution(DOMAIN, "nd", "signal", TL)
+        p = TaskPoller(c.active, DOMAIN, TL,
+                       {"nd": SignalDecider(expected_signals=2)})
+        p.drain()
+        c.replicate()
+        c.split_brain_promote(DOMAIN)
+        c.active.frontend.signal_workflow_execution(DOMAIN, "nd", "a1")
+        p.drain()
+        sp = TaskPoller(c.standby, DOMAIN, TL,
+                        {"nd": SignalDecider(expected_signals=2)})
+        c.standby.frontend.signal_workflow_execution(DOMAIN, "nd", "b1")
+        sp.drain()
+        c.replicate()  # loser suffix arrives → fork on standby
+
+        domain_id = c.standby.stores.domain.by_name(DOMAIN).domain_id
+        run_id = c.standby.stores.execution.get_current_run_id(domain_id, "nd")
+        before = c.standby.stores.execution.get_workflow(domain_id, "nd", run_id)
+        n_branches = len(before.version_histories.histories)
+        assert n_branches == 2
+        cur_index = before.version_histories.current_index
+
+        stores, report = recover_stores(wal)
+        assert report.ok
+        after = stores.execution.get_workflow(domain_id, "nd", run_id)
+        assert len(after.version_histories.histories) == n_branches
+        assert after.version_histories.current_index == cur_index
+        assert ([(i.event_id, i.version)
+                 for i in after.version_histories.current().items] ==
+                [(i.event_id, i.version)
+                 for i in before.version_histories.current().items])
+
+    def test_replication_queue_survives_crash(self, tmp_path):
+        """The active's outbound replication queue is durable: a recovered
+        active cluster can still feed a standby from the start."""
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+        wal = str(tmp_path / "active.jsonl")
+        c = ReplicatedClusters(num_hosts=1, num_shards=4,
+                               active_stores=open_durable_stores(wal))
+        c.register_global_domain(DOMAIN)
+        c.active.frontend.start_workflow_execution(DOMAIN, "rq", "echo", TL)
+        TaskPoller(c.active, DOMAIN, TL, {"rq": EchoDecider(TL)}).drain()
+        # crash the active BEFORE replicating
+        stores, report = recover_stores(wal)
+        assert report.ok
+        c2 = ReplicatedClusters(num_hosts=1, num_shards=4,
+                                active_stores=stores)
+        c2.register_global_domain(DOMAIN + "-2")  # fresh standby needs domain
+        applied = c2.replicate()
+        assert applied > 0
+        domain_id = stores.domain.by_name(DOMAIN).domain_id
+        run_id = stores.execution.get_current_run_id(domain_id, "rq")
+        standby_ms = c2.standby.stores.execution.get_workflow(
+            domain_id, "rq", run_id)
+        assert standby_ms.execution_info.close_status == CloseStatus.Completed
