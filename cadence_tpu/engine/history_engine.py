@@ -87,23 +87,43 @@ class HistoryEngine:
         #: engines created before/after wiring ({"pub": ReplicationPublisher})
         self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
 
+    def _replication_target(self, domain_id: str, ms: MutableState):
+        """Shared gate for both replication publish paths: (publisher,
+        source-branch version-history items), or None when the domain isn't
+        global or no publisher is wired."""
+        pub = self.replication_publisher_holder.get("pub")
+        if pub is None:
+            return None
+        try:
+            if len(self.stores.domain.by_id(domain_id).clusters) < 2:
+                return None
+        except EntityNotExistsError:
+            return None
+        items = tuple((i.event_id, i.version)
+                      for i in ms.version_histories.current().items)
+        return pub, items
+
     def _publish_replication(self, domain_id: str, workflow_id: str,
                              run_id: str, events, ms: MutableState) -> None:
         """insertReplicationTasks analog: global domains stream every
         committed batch to remote clusters, carrying the source branch's
         version-history items for NDC branch selection."""
-        pub = self.replication_publisher_holder.get("pub")
-        if pub is None:
+        target = self._replication_target(domain_id, ms)
+        if target is None:
             return
-        try:
-            if len(self.stores.domain.by_id(domain_id).clusters) < 2:
-                return
-        except EntityNotExistsError:
-            return
-        items = tuple((i.event_id, i.version)
-                      for i in ms.version_histories.current().items)
+        pub, items = target
         pub.publish(domain_id, workflow_id, run_id, events,
                     version_history_items=items)
+
+    def _publish_sync_activity(self, ms: MutableState, ai) -> None:
+        """Stream one activity's transient attempt/failure state to
+        standbys (syncActivityTasks analog; no history events exist for
+        transient retries, so this is the only carrier)."""
+        target = self._replication_target(ms.execution_info.domain_id, ms)
+        if target is None:
+            return
+        pub, items = target
+        pub.publish_sync_activity(ms, ai, items)
 
     # ------------------------------------------------------------------
     # transaction plumbing
@@ -170,10 +190,12 @@ class HistoryEngine:
         if retry_policy is not None:
             start_attrs["retry_policy"] = retry_policy
             if expiration_timestamp == 0 and retry_policy.expiration_interval_seconds:
-                # first run of a retrying workflow pins the chain's deadline
-                # (startWorkflowHelper expiration computation)
-                expiration_timestamp = now + \
-                    retry_policy.expiration_interval_seconds * 1_000_000_000
+                # the deadline runs from the first decision schedule to the
+                # end of the workflow, so a delayed first decision extends it
+                # (mutable_state_builder.go:1646-1652)
+                expiration_timestamp = now + (
+                    retry_policy.expiration_interval_seconds
+                    + first_decision_backoff) * 1_000_000_000
         if initiator is not None:
             start_attrs["initiator"] = int(initiator)
         if attempt:
@@ -370,13 +392,18 @@ class HistoryEngine:
 
     def _cron_backoff_seconds(self, ms: MutableState) -> int:
         """GetCronBackoffDuration analog: seconds until the next cron run
-        measured from now, or -1 (backoff/cron.go:48)."""
+        measured from now, or -1 (backoff/cron.go:48). The schedule anchors
+        at the EXECUTION time — start + first-decision backoff
+        (mutable_state_builder.go:1062-1072) — so a run closing exactly at
+        its own fire time doesn't re-fire the same slot."""
         from ..utils.backoff import NO_BACKOFF, get_backoff_for_next_schedule
         info = ms.execution_info
         if not info.cron_schedule:
             return NO_BACKOFF
+        anchor = info.start_timestamp \
+            + info.first_decision_backoff * 1_000_000_000
         return get_backoff_for_next_schedule(
-            info.cron_schedule, info.start_timestamp, self.clock.now())
+            info.cron_schedule, anchor, self.clock.now())
 
     def _workflow_retry_backoff_seconds(self, ms: MutableState,
                                         failure_reason: str):
@@ -455,8 +482,14 @@ class HistoryEngine:
             retry_policy=retry_policy,
             initiator=attrs.get("initiator"),
             attempt=attrs.get("attempt", 0) or 0,
-            # a retry chain shares the FIRST run's expiration deadline
-            expiration_timestamp=info.expiration_time,
+            # only a RetryPolicy chain shares the FIRST run's expiration
+            # deadline; cron/decider chains recompute it from now so retries
+            # aren't silently disabled once the original deadline passes
+            # (mutable_state_builder.go:1646-1661)
+            expiration_timestamp=(
+                info.expiration_time
+                if attrs.get("initiator") == ContinueAsNewInitiator.RetryPolicy
+                else 0),
             request_id=f"can-{new_run_id}",
             # the continued run keeps the workflow ID and MUST use the run ID
             # recorded in the ContinuedAsNew event, or the persisted chain
@@ -502,6 +535,7 @@ class HistoryEngine:
             ai.started_time = now
             ai.last_heartbeat_updated_time = now
             self._commit_transient(ms, expected)
+            self._publish_sync_activity(ms, ai)
             return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
                              run_id=run_id, schedule_id=schedule_id,
                              started_id=TRANSIENT_EVENT_ID,
@@ -547,6 +581,7 @@ class HistoryEngine:
         if try_retry and retry_activity(ms, ai, self.clock.now(),
                                         extra.get("reason", "")):
             self._commit_transient(ms, expected)
+            self._publish_sync_activity(ms, ai)
             return
         txn = self._new_transaction(ms)
         started_id = token.started_id
@@ -574,7 +609,11 @@ class HistoryEngine:
                           expected_next_event_id: int) -> None:
         """Persist a mutable-state-only change (no history events): the
         transient activity start/retry transaction. Runs the timer sequence
-        like every transaction close (CloseTransactionAsMutation)."""
+        like every transaction close (CloseTransactionAsMutation).
+
+        Replication: a sync-activity message (reference
+        mutable_state_builder.go:3864 syncActivityTasks) streams the
+        attempt/failure state to standbys; see _publish_sync_activity."""
         from ..oracle import task_generator as taskgen
         taskgen.generate_activity_timer_tasks(ms)
         taskgen.generate_user_timer_tasks(ms)
@@ -662,6 +701,7 @@ class HistoryEngine:
         if tt in (TimeoutType.StartToClose, TimeoutType.Heartbeat):
             if retry_activity(ms, ai, self.clock.now(), f"cadenceInternal:Timeout {tt.name}"):
                 self._commit_transient(ms, expected)
+                self._publish_sync_activity(ms, ai)
                 return
         txn = self._new_transaction(ms)
         started_id = ai.started_id

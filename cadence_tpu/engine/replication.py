@@ -67,6 +67,30 @@ class ReplicationTask:
     version_history_items: Tuple[Tuple[int, int], ...] = ()
 
 
+@dataclass
+class SyncActivityTask:
+    """Transient activity state crossing the cluster boundary
+    (types.SyncActivityRequest analog; published on transient activity
+    start/retry/heartbeat commits, which write NO history events — without
+    it a standby never learns attempt counts or last-failure state,
+    reference mutable_state_builder.go:3864 syncActivityTasks)."""
+
+    domain_id: str
+    workflow_id: str
+    run_id: str
+    version: int
+    schedule_id: int
+    scheduled_time: int
+    started_id: int
+    started_time: int
+    last_heartbeat_time: int
+    attempt: int
+    last_failure_reason: str = ""
+    last_failure_details: bytes = b""
+    last_worker_identity: str = ""
+    version_history_items: Tuple[Tuple[int, int], ...] = ()
+
+
 class RetryReplicationError(Exception):
     """Gap detected: events [from_event_id, to_event_id) must be resent
     first (types.RetryTaskV2Error analog)."""
@@ -97,6 +121,25 @@ class ReplicationPublisher:
             version_history_items=version_history_items,
         )
         self.stores.queue.enqueue(REPLICATION_QUEUE, task)
+
+    def publish_sync_activity(self, ms, ai,
+                              version_history_items: Tuple[Tuple[int, int], ...]
+                              ) -> None:
+        """Queue a SyncActivity task for one pending activity's transient
+        state (replicationTask TypeSyncActivity hydration)."""
+        info = ms.execution_info
+        self.stores.queue.enqueue(REPLICATION_QUEUE, SyncActivityTask(
+            domain_id=info.domain_id, workflow_id=info.workflow_id,
+            run_id=info.run_id, version=ai.version,
+            schedule_id=ai.schedule_id, scheduled_time=ai.scheduled_time,
+            started_id=ai.started_id, started_time=ai.started_time,
+            last_heartbeat_time=ai.last_heartbeat_updated_time,
+            attempt=ai.attempt,
+            last_failure_reason=ai.last_failure_reason,
+            last_failure_details=ai.last_failure_details,
+            last_worker_identity=ai.last_worker_identity,
+            version_history_items=version_history_items,
+        ))
 
     def read_tasks(self, from_index: int, count: int = 100
                    ) -> List[Tuple[int, ReplicationTask]]:
@@ -192,6 +235,75 @@ class HistoryReplicator:
             return self._apply_to_current(key, ms, task, batches)
         return self._apply_to_noncurrent(key, ms, task, batches, branch_index,
                                          fork_spec)
+
+    def sync_activity(self, task: SyncActivityTask) -> bool:
+        """Apply transient activity state to the standby's pending activity
+        (ndc/activity_replicator.go:77 SyncActivity + shouldApplySyncActivity
+        :210). Returns False when the task is stale/dropped; raises
+        RetryReplicationError when local history is missing events."""
+        from ..core.enums import WorkflowState
+        from ..oracle.mutable_state import VersionHistoryItem
+        key = (task.domain_id, task.workflow_id, task.run_id)
+        try:
+            ms = self.stores.execution.get_workflow(*key)
+        except EntityNotExistsError:
+            # start event and sync-activity out of order, or run long gone:
+            # throw the task away (activity_replicator.go:108-115)
+            return False
+        if ms.execution_info.state == WorkflowState.Completed:
+            return False
+
+        local = ms.version_histories.current()
+        incoming = [VersionHistoryItem(e, v)
+                    for e, v in task.version_history_items] or \
+            [VersionHistoryItem(task.schedule_id, task.version)]
+        lca = local.find_lca_item(incoming)
+        incoming_vh = type(local)(items=incoming)
+        if local.is_lca_appendable(lca) or incoming_vh.is_lca_appendable(lca):
+            # case 1 (one history is a prefix of the other): resend when the
+            # schedule event is past what this side holds
+            if task.schedule_id > lca.event_id:
+                raise RetryReplicationError(lca.event_id + 1,
+                                            task.schedule_id + 1)
+        else:
+            # case 2 (diverged): lower incoming version discards; higher
+            # incoming version needs the missing events first
+            if incoming[-1].version < local.last_item().version:
+                return False
+            if incoming[-1].version > local.last_item().version:
+                raise RetryReplicationError(lca.event_id + 1,
+                                            task.schedule_id + 1)
+
+        ms = copy.deepcopy(ms)
+        ai = ms.pending_activity_info_ids.get(task.schedule_id)
+        if ai is None:
+            return False  # activity already finished (out-of-order delivery)
+        if ai.version > task.version:
+            return False  # failover/reset superseded this attempt
+        if ai.version == task.version:
+            if ai.attempt > task.attempt:
+                return False
+            if (ai.attempt == task.attempt
+                    and ai.last_heartbeat_updated_time > task.last_heartbeat_time):
+                return False
+
+        # ReplicateActivityInfo: overwrite transient fields; reset the timer
+        # bits when the attempt advanced so refreshed timers re-create
+        if ai.version != task.version or ai.attempt < task.attempt:
+            from ..core.enums import TIMER_TASK_STATUS_NONE
+            ai.timer_task_status = TIMER_TASK_STATUS_NONE
+        ai.version = task.version
+        ai.scheduled_time = task.scheduled_time
+        ai.started_id = task.started_id
+        ai.started_time = task.started_time
+        ai.last_heartbeat_updated_time = task.last_heartbeat_time
+        ai.attempt = task.attempt
+        ai.last_failure_reason = task.last_failure_reason
+        ai.last_failure_details = task.last_failure_details
+        ai.last_worker_identity = task.last_worker_identity
+        self.stores.execution.upsert_workflow(
+            ms, set_current=self._wins_current(key, ms))
+        return True
 
     @staticmethod
     def _incoming_items(task: ReplicationTask):
@@ -370,11 +482,17 @@ class ReplicationTaskProcessor:
         self.deduped = 0
         self.resends = 0
 
+    def _apply_task(self, task) -> bool:
+        """Dispatch by task type (replication/task_executor.go:80 execute)."""
+        if isinstance(task, SyncActivityTask):
+            return self.replicator.sync_activity(task)
+        return self.replicator.apply(task)
+
     def process_once(self, batch_size: int = 100) -> int:
         tasks = self.source.read_tasks(self.ack_index, batch_size)
         for index, task in tasks:
             try:
-                if self.replicator.apply(task):
+                if self._apply_task(task):
                     self.applied += 1
                 else:
                     self.deduped += 1
@@ -416,7 +534,7 @@ class ReplicationTaskProcessor:
                     version_history_items=_items_until(
                         task.version_history_items, last_id),
                 ))
-            applied = self.replicator.apply(task)
+            applied = self._apply_task(task)
         except (RetryReplicationError, ReplayError) as err:
             self.stores.queue.enqueue(
                 REPLICATION_DLQ, DLQEntry(task=task, error=str(err)))
@@ -437,7 +555,7 @@ class ReplicationTaskProcessor:
         ok = 0
         for entry in entries:
             try:
-                if self.replicator.apply(entry.task):
+                if self._apply_task(entry.task):
                     ok += 1
             except (RetryReplicationError, ReplayError):
                 pass

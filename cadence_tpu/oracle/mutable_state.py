@@ -146,6 +146,7 @@ class VersionHistories:
         branch sharing the deepest common ancestor with the remote items."""
         best_index = -1
         best_item: Optional[VersionHistoryItem] = None
+        best_len = 0
         for index, history in enumerate(self.histories):
             if history.is_empty():
                 continue
@@ -153,8 +154,15 @@ class VersionHistories:
                 item = history.find_lca_item(remote_items)
             except ReplayError:
                 continue
-            if best_item is None or item.event_id > best_item.event_id:
+            # tie-break on equal LCA event ids: prefer the branch with the
+            # shorter item list, so an incoming batch appends to the branch
+            # whose head IS the LCA instead of forking a duplicate
+            # (versionHistories.go FindLCAVersionHistoryIndexAndItem)
+            if (best_item is None or item.event_id > best_item.event_id
+                    or (item.event_id == best_item.event_id
+                        and len(history.items) < best_len)):
                 best_index, best_item = index, item
+                best_len = len(history.items)
         if best_item is None:
             raise ReplayError("no local branch shares an ancestor with remote")
         return best_index, best_item
@@ -288,6 +296,9 @@ class ExecutionInfo:
     create_request_id: str = ""
     signal_count: int = 0
     cron_schedule: str = ""
+    #: start event's FirstDecisionTaskBackoffSeconds, kept here so cron
+    #: anchor math (GetCronBackoffDuration) needn't re-read the start event
+    first_decision_backoff: int = 0
 
     sticky_task_list: str = ""
     sticky_schedule_to_start_timeout: int = 0

@@ -280,6 +280,8 @@ class StateBuilder:
         info.decision_timeout = 0
 
         info.cron_schedule = event.get("cron_schedule", "") or ""
+        info.first_decision_backoff = event.get(
+            "first_decision_task_backoff_seconds", 0) or 0
 
         parent_domain_id = event.get("parent_workflow_domain_id")
         if parent_domain_id:

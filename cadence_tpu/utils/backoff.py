@@ -100,6 +100,12 @@ class CronField:
         return value in self.allowed
 
 
+def _has_star_bit(spec: str) -> bool:
+    """True when any comma part's range (before an optional '/step') is
+    '*'/'?' — robfig/cron's starBit, OR'd across parts."""
+    return any(part.split("/", 1)[0] in ("*", "?") for part in spec.split(","))
+
+
 class CronSchedule:
     """Parsed 5-field standard cron expression."""
 
@@ -113,18 +119,22 @@ class CronSchedule:
         self.month = CronField(fields[3], 1, 12)
         # cron day-of-week: 0-6, 0 == Sunday (7 accepted as a Sunday alias)
         self.dow = CronField(fields[4], 0, 7)
-        #: dom/dow OR-semantics apply when both are restricted (std cron)
-        self.dom_star = fields[2] in ("*", "?")
-        self.dow_star = fields[4] in ("*", "?")
+        #: dom/dow OR-semantics apply when both are restricted (std cron).
+        #: robfig/cron sets the star bit for any part whose base range is
+        #: '*' or '?' — including '*/n' — so those count as unrestricted too
+        self.dom_star = _has_star_bit(fields[2])
+        self.dow_star = _has_star_bit(fields[4])
 
     def _day_match(self, t: datetime) -> bool:
         dom_ok = self.dom.match(t.day)
         cron_dow = (t.weekday() + 1) % 7  # python Mon=0 → cron Sun=0
         dow_ok = self.dow.match(cron_dow) or (cron_dow == 0 and self.dow.match(7))
-        if self.dom_star:
-            return dow_ok
-        if self.dow_star:
-            return dom_ok
+        # robfig/cron v1.2.0 dayMatches (the version the reference pins):
+        # AND the two day fields when either carries the star bit — which
+        # v1.2.0 keeps for '*/n' — OR them when both are restricted.
+        # (cron v3 clears the bit for step>1; not the pinned behavior.)
+        if self.dom_star or self.dow_star:
+            return dom_ok and dow_ok
         return dom_ok or dow_ok
 
     def next_after(self, t: datetime) -> Optional[datetime]:

@@ -202,6 +202,49 @@ class TestFailover:
         events = sbox.frontend.get_workflow_execution_history(DOMAIN, "xdc-timer")
         assert any(e.event_type.name == "TimerFired" for e in events)
 
+    def test_sync_activity_replicates_transient_attempts(self, clusters):
+        """Transient activity retries write no history events; the standby
+        learns attempt counts and last-failure state through SyncActivity
+        tasks (ndc/activity_replicator.go:77)."""
+        from cadence_tpu.models.deciders import RetryActivityDecider
+        box = clusters.active
+        box.frontend.start_workflow_execution(DOMAIN, "xdc-sync", "retry", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"xdc-sync": RetryActivityDecider(TL)})
+        box.pump_once()
+        assert poller.poll_and_decide_once()
+        box.pump_once()
+        resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_failed(resp.token, "boom")
+        clusters.replicate()
+
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "xdc-sync")
+        sms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "xdc-sync", run_id)
+        assert len(sms.pending_activity_info_ids) == 1
+        ai = next(iter(sms.pending_activity_info_ids.values()))
+        assert ai.attempt == 1
+        assert ai.last_failure_reason == "boom"
+        # stale re-delivery of an older attempt must not regress the standby
+        ams = box.stores.execution.get_workflow(domain_id, "xdc-sync", run_id)
+        aai = next(iter(ams.pending_activity_info_ids.values()))
+        stale_attempt = aai.attempt - 1
+        from cadence_tpu.engine.replication import SyncActivityTask
+        items = tuple((i.event_id, i.version)
+                      for i in ams.version_histories.current().items)
+        stale = SyncActivityTask(
+            domain_id=domain_id, workflow_id="xdc-sync", run_id=run_id,
+            version=aai.version, schedule_id=aai.schedule_id,
+            scheduled_time=0, started_id=-1, started_time=0,
+            last_heartbeat_time=0, attempt=stale_attempt,
+            last_failure_reason="old", version_history_items=items)
+        assert clusters.processor.replicator.sync_activity(stale) is False
+        sms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "xdc-sync", run_id)
+        ai = next(iter(sms.pending_activity_info_ids.values()))
+        assert ai.attempt == 1 and ai.last_failure_reason == "boom"
+
 
 class TestStreamingReplay:
     def test_chunked_matches_single_shot(self):

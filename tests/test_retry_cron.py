@@ -184,6 +184,38 @@ class TestCron:
                 if k[1] == "cron-1"]
         assert len(runs) == 3  # second completion chained a third run
 
+    def test_cron_chain_recomputes_retry_expiration(self, box):
+        """A cron-initiated continue-as-new must NOT inherit the first run's
+        retry deadline: the reference recalculates it (now + expiration
+        interval + first-decision backoff, mutable_state_builder.go:1646-1652)
+        so later iterations keep their retry budget."""
+        from cadence_tpu.core.events import RetryPolicy
+        box.frontend.start_workflow_execution(
+            DOMAIN, "cron-exp", "cron-type", TL, cron_schedule="* * * * *",
+            retry_policy=RetryPolicy(initial_interval_seconds=1,
+                                     backoff_coefficient=2.0,
+                                     maximum_interval_seconds=10,
+                                     expiration_interval_seconds=30))
+        poller = TaskPoller(box, DOMAIN, TL, {"cron-exp": CompleteDecider()})
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run1 = [box.stores.execution.get_workflow(*k)
+                for k in box.stores.execution.list_executions()
+                if k[1] == "cron-exp"
+                and box.stores.execution.get_workflow(*k)
+                .execution_info.close_status == CloseStatus.ContinuedAsNew][0]
+        current = box.stores.execution.get_current_run_id(domain_id, "cron-exp")
+        run2 = box.stores.execution.get_workflow(domain_id, "cron-exp", current)
+        # the chained run's deadline is fresh (recomputed from its start,
+        # which includes the cron backoff), not the first run's
+        assert run2.execution_info.expiration_time > \
+            run1.execution_info.expiration_time
+        start2 = box.stores.history.read_events(
+            domain_id, "cron-exp", current)[0]
+        backoff = start2.get("first_decision_task_backoff_seconds") or 0
+        assert run2.execution_info.expiration_time >= \
+            run2.execution_info.start_timestamp + (30 + backoff) * SECOND
+
     def test_cron_second_run_carries_initiator(self, box):
         box.frontend.start_workflow_execution(
             DOMAIN, "cron-2", "cron-type", TL, cron_schedule="* * * * *")
@@ -260,6 +292,20 @@ class TestBackoffMath:
         # "15 * * * *": close at 10:20 → next 11:15 → 3300s
         close = (10 * 3600 + 20 * 60) * SECOND
         assert get_backoff_for_next_schedule("15 * * * *", 0, close) == 3300
+
+    def test_cron_step_star_keeps_star_bit(self):
+        """robfig/cron v1.2.0 keeps the star bit for '*/n', and a star bit
+        on either day field switches day matching to AND: '0 0 */2 * 1'
+        fires on odd days that are ALSO Mondays — not Sat Jan 3 (odd,
+        non-Monday, the OR answer) and not Mon Jan 12 (even Monday)."""
+        from cadence_tpu.utils.backoff import CronSchedule
+        s = CronSchedule("0 0 */2 * 1")
+        assert s.dom_star and not s.dow_star
+        from datetime import datetime, timezone
+        nxt = s.next_after(datetime(2026, 1, 1, tzinfo=timezone.utc))
+        assert (nxt.year, nxt.month, nxt.day) == (2026, 1, 5)
+        nxt = s.next_after(nxt)
+        assert (nxt.year, nxt.month, nxt.day) == (2026, 1, 19)
 
     def test_invalid_cron(self):
         assert get_backoff_for_next_schedule("bogus", 0, 0) == NO_BACKOFF
