@@ -53,6 +53,10 @@ class PayloadLayout:
     max_children: int = 8
     max_request_cancels: int = 8
     max_signals: int = 8
+    #: version-history branches the kernel can carry per workflow (NDC
+    #: divergence); does not affect the payload width — the canonical
+    #: payload covers the CURRENT branch only (checksum.go:92-100)
+    max_branches: int = 2
 
     NUM_SCALARS = 11  # fields before the version-history block
 

@@ -66,6 +66,56 @@ class TPUReplayEngine:
         rows, _crcs, errors = replay_corpus(histories, self.layout)
         return rows, errors
 
+    def tree_segments(self, key: Tuple[str, str, str]) -> list:
+        """One run's full branch tree as encode_segments input: the current
+        branch's lineage replays state-carrying; every other branch's
+        events beyond the shared prefix are emitted VH-only with
+        fork-inheritance from the current branch — the device then holds
+        the complete VersionHistories (winner state + loser branch items),
+        matching the post-conflict-resolution mutable state
+        (ndc/conflict_resolver.go + versionHistories.go on device)."""
+        from ..core.events import HistoryBatch
+
+        hs = self.stores.history
+        current = hs.get_current_branch(*key)
+        cur_lineage = hs.as_history_batches(*key, branch=current)
+        segments = [(cur_lineage, current, current, False)]
+        cur_events = [e for b in cur_lineage for e in b.events]
+        for index in range(hs.branch_count(*key)):
+            if index == current:
+                continue
+            events = hs.read_events(*key, branch=index)
+            shared = 0
+            while (shared < min(len(events), len(cur_events))
+                   and events[shared].id == cur_events[shared].id
+                   and events[shared].version == cur_events[shared].version):
+                shared += 1
+            unique = events[shared:]
+            if not unique:
+                continue
+            segments.append((
+                [HistoryBatch(domain_id=key[0], workflow_id=key[1],
+                              run_id=key[2], events=unique)],
+                index, current, True,
+            ))
+        return segments
+
+    def replay_tree_payloads(self, keys: Sequence[Tuple[str, str, str]]
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Device-replay full branch trees (divergent histories included);
+        returns (payload rows, errors, device-chosen current branch)."""
+        import jax.numpy as jnp
+
+        from ..ops.encode import encode_segment_corpus
+        from ..ops.payload import payload_rows
+        from ..ops.replay import replay_events
+
+        corpus = encode_segment_corpus([self.tree_segments(k) for k in keys])
+        state = replay_events(jnp.asarray(corpus), self.layout)
+        rows = payload_rows(state, self.layout)
+        return (np.asarray(rows), np.asarray(state.error),
+                np.asarray(state.current_branch))
+
     def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
                    ) -> BulkVerifyResult:
         """Replay persisted histories on device and compare against the live
@@ -76,7 +126,7 @@ class TPUReplayEngine:
         keys = list(keys)
         if not keys:
             return BulkVerifyResult(total=0, verified_on_device=0)
-        rows, errors = self.replay_payloads(keys)
+        rows, errors, device_branch = self.replay_tree_payloads(keys)
 
         result = BulkVerifyResult(total=len(keys), verified_on_device=0)
         for i, key in enumerate(keys):
@@ -93,5 +143,9 @@ class TPUReplayEngine:
             else:
                 result.verified_on_device += 1
                 if not (rows[i] == expected).all():
+                    result.divergent.append(key)
+                elif device_branch[i] != live_ms.version_histories.current_index:
+                    # device-side branch arbitration must agree with the
+                    # store's conflict-resolution outcome
                     result.divergent.append(key)
         return result

@@ -54,9 +54,20 @@ def pack_serialized(blobs: Sequence[bytes], max_events: int,
 
 
 def encode_corpus_native(histories, max_events: int = 0) -> np.ndarray:
-    """Drop-in native replacement for ops.encode.encode_corpus."""
+    """Drop-in native replacement for ops.encode.encode_corpus.
+
+    Continue-as-new chains (batches with new_run_events) are not yet wired
+    through the wire codec / C++ packer — refuse loudly rather than silently
+    dropping the chained run (the Python packer chains via FLAG_RUN_RESET)."""
     from ..core.codec import serialize_corpus
 
+    for h in histories:
+        for b in h:
+            if b.new_run_events:
+                raise ValueError(
+                    "native packer does not chain new_run_events yet; use "
+                    "ops.encode.encode_corpus for continued-as-new histories"
+                )
     if max_events <= 0:
         max_events = max(sum(len(b.events) for b in h) for h in histories)
     return pack_serialized(serialize_corpus(histories), max_events)

@@ -29,7 +29,17 @@ LANE_BATCH_FIRST = 5  # first event ID of the enclosing batch
 LANE_BATCH_LAST = 6   # 1 if this is the last event of its batch
 LANE_A0 = 7
 NUM_ATTR_LANES = 8
-NUM_LANES = LANE_A0 + NUM_ATTR_LANES  # 15
+# tree/chain lanes (after the attribute block so attr indices stay stable)
+LANE_BRANCH = LANE_A0 + NUM_ATTR_LANES      # version-history branch index
+LANE_PARENT = LANE_BRANCH + 1               # branch to fork-inherit items from
+LANE_FLAGS = LANE_PARENT + 1                # FLAG_* bitmask
+NUM_LANES = LANE_FLAGS + 1  # 18
+
+# LANE_FLAGS bits
+FLAG_RUN_RESET = 1  # first event of a continued-as-new run: reset row state
+FLAG_VH_ONLY = 2    # event updates its branch's version history only (the
+                    # non-current-branch persist path of NDC conflict
+                    # resolution, ndc/branch_manager.go); no state transition
 
 
 class _Interner:
@@ -119,22 +129,24 @@ def _encode_attrs(ev, interner: _Interner) -> List[int]:
 
 
 def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarray:
-    """Pack one workflow's batched history into [E, L] lanes (zero-padded)."""
+    """Pack one workflow's batched history into [E, L] lanes (zero-padded).
+
+    A batch carrying `new_run_events` (continue-as-new: cron, retry, or an
+    explicit ContinueAsNew decision) chains the new run into the SAME row:
+    its first event is flagged FLAG_RUN_RESET, which makes the kernel reset
+    that workflow's carried state at the boundary (the device analog of the
+    reference starting a fresh mutableStateBuilder for the new run,
+    state_builder.go:446-520 applyEvents newRunHistory). The row's final
+    state is therefore the LAST run's state."""
     out = np.zeros((max_events, NUM_LANES), dtype=np.int64)
     out[:, LANE_EVENT_TYPE] = -1
     interner = _Interner()
     row = 0
-    for batch in batches:
-        if batch.new_run_events:
-            # continued-as-new chains are split host-side: the caller must
-            # append the new run as its own workflow row (the device kernel
-            # replays runs, not chains). Loud failure beats silent drop.
-            raise ValueError(
-                "batch carries new_run_events; split the continued-as-new "
-                "run into its own workflow row before encoding"
-            )
-        first_id = batch.events[0].id
-        for j, ev in enumerate(batch.events):
+
+    def emit(events, reset_first):
+        nonlocal row
+        first_id = events[0].id
+        for j, ev in enumerate(events):
             if row >= max_events:
                 raise OverflowError(
                     f"history has more than {max_events} events"
@@ -145,17 +157,108 @@ def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarr
             out[row, LANE_TIMESTAMP] = ev.timestamp
             out[row, LANE_TASK_ID] = ev.task_id
             out[row, LANE_BATCH_FIRST] = first_id
-            out[row, LANE_BATCH_LAST] = 1 if j == len(batch.events) - 1 else 0
-            out[row, LANE_A0:] = _encode_attrs(ev, interner)
+            out[row, LANE_BATCH_LAST] = 1 if j == len(events) - 1 else 0
+            out[row, LANE_A0:LANE_A0 + NUM_ATTR_LANES] = _encode_attrs(ev, interner)
+            if reset_first and j == 0:
+                out[row, LANE_FLAGS] = FLAG_RUN_RESET
             row += 1
+
+    for batch in batches:
+        emit(batch.events, False)
+        if batch.new_run_events:
+            # fresh interner: the new run's string IDs are a new namespace
+            interner = _Interner()
+            emit(batch.new_run_events, True)
     return out
+
+
+def encode_chain(runs: Sequence[Sequence[HistoryBatch]],
+                 max_events: int) -> np.ndarray:
+    """Pack a continue-as-new chain (a list of runs, each a list of batches)
+    into one [E, L] row: each later run starts with FLAG_RUN_RESET."""
+    out = np.zeros((max_events, NUM_LANES), dtype=np.int64)
+    out[:, LANE_EVENT_TYPE] = -1
+    row = 0
+    for r, run in enumerate(runs):
+        part = encode_history(run, max_events - row)
+        n = int((part[:, LANE_EVENT_ID] > 0).sum())
+        out[row:row + n] = part[:n]
+        if r > 0:
+            out[row, LANE_FLAGS] = int(out[row, LANE_FLAGS]) | FLAG_RUN_RESET
+        row += n
+    return out
+
+
+def encode_segments(segments: Sequence[tuple], max_events: int) -> np.ndarray:
+    """Pack one workflow's branched history tree into [E, L] lanes.
+
+    Each segment is (batches, branch, parent, vh_only):
+    - `branch`: version-history branch index these events belong to;
+    - `parent`: branch whose items the target branch fork-inherits when it
+      receives its first item (versionHistory.go DuplicateUntilLCAItem on
+      device); pass parent == branch for no inheritance;
+    - `vh_only`: True for events persisted to a non-current branch without
+      touching mutable state (ndc conflict resolution's passive persist).
+
+    Segments are emitted in order; interning is shared across segments (all
+    branches of a run share the workflow's string namespace)."""
+    out = np.zeros((max_events, NUM_LANES), dtype=np.int64)
+    out[:, LANE_EVENT_TYPE] = -1
+    interner = _Interner()
+    row = 0
+    for batches, branch, parent, vh_only in segments:
+        flags = FLAG_VH_ONLY if vh_only else 0
+        for batch in batches:
+            if batch.new_run_events:
+                # segment encoding is per-run (branch trees belong to ONE
+                # run); chains must go through encode_history/encode_chain
+                raise ValueError(
+                    "segment batch carries new_run_events; encode the "
+                    "continued-as-new chain via encode_chain instead"
+                )
+            first_id = batch.events[0].id
+            for j, ev in enumerate(batch.events):
+                if row >= max_events:
+                    raise OverflowError(
+                        f"history has more than {max_events} events"
+                    )
+                out[row, LANE_EVENT_ID] = ev.id
+                out[row, LANE_EVENT_TYPE] = int(ev.event_type)
+                out[row, LANE_VERSION] = ev.version
+                out[row, LANE_TIMESTAMP] = ev.timestamp
+                out[row, LANE_TASK_ID] = ev.task_id
+                out[row, LANE_BATCH_FIRST] = first_id
+                out[row, LANE_BATCH_LAST] = 1 if j == len(batch.events) - 1 else 0
+                out[row, LANE_A0:LANE_A0 + NUM_ATTR_LANES] = _encode_attrs(ev, interner)
+                out[row, LANE_BRANCH] = branch
+                out[row, LANE_PARENT] = parent
+                out[row, LANE_FLAGS] = flags
+                row += 1
+    return out
+
+
+def encode_segment_corpus(workflows: Sequence[Sequence[tuple]],
+                          max_events: int = 0) -> np.ndarray:
+    """Pack a corpus of branched histories (each a segment list) into
+    [W, E, L]."""
+    if max_events <= 0:
+        max_events = max(
+            sum(sum(len(b.events) for b in seg[0]) for seg in segs)
+            for segs in workflows
+        )
+    return np.stack([encode_segments(s, max_events) for s in workflows])
+
+
+def history_length(batches: Sequence[HistoryBatch]) -> int:
+    """Total packed rows for one history, counting chained new-run events."""
+    return sum(
+        len(b.events) + len(b.new_run_events or ()) for b in batches
+    )
 
 
 def encode_corpus(histories: Sequence[Sequence[HistoryBatch]],
                   max_events: int = 0) -> np.ndarray:
     """Pack a corpus into [W, E, L]; E = max history length (or `max_events`)."""
     if max_events <= 0:
-        max_events = max(
-            sum(len(b.events) for b in h) for h in histories
-        )
+        max_events = max(history_length(h) for h in histories)
     return np.stack([encode_history(h, max_events) for h in histories])

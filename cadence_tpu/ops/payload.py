@@ -44,11 +44,19 @@ def payload_rows(s: ReplayState, layout: PayloadLayout = DEFAULT_LAYOUT) -> jnp.
         ],
         axis=1,
     )
+    # the canonical payload covers the CURRENT branch only (checksum.go:92);
+    # gather it out of the per-branch tables
+    bidx = s.current_branch.astype(jnp.int32)
+    vh_event_ids = jnp.take_along_axis(
+        s.vh_event_ids, bidx[:, None, None], axis=1).squeeze(1)
+    vh_versions = jnp.take_along_axis(
+        s.vh_versions, bidx[:, None, None], axis=1).squeeze(1)
+    vh_count = jnp.take_along_axis(s.vh_count, bidx[:, None], axis=1).squeeze(1)
     # interleave (event_id, version) pairs; slots beyond vh_count are PAD-filled
-    vh_pairs = jnp.stack([s.vh_event_ids, s.vh_versions], axis=2).reshape(W, 2 * Kv)
+    vh_pairs = jnp.stack([vh_event_ids, vh_versions], axis=2).reshape(W, 2 * Kv)
     parts = [
         scalars,
-        s.vh_count.astype(jnp.int64)[:, None],
+        vh_count.astype(jnp.int64)[:, None],
         vh_pairs,
         _count(s.timers.occ)[:, None],
         _sorted_ids(s.timers.occ, s.timers.started_id),

@@ -116,11 +116,14 @@ class ReplayState(NamedTuple):
     workflow_attempt: jnp.ndarray      # [W] i64
     expiration_time: jnp.ndarray       # [W] i64 nanos
     has_parent: jnp.ndarray            # [W] bool
-    # version bookkeeping
+    # version bookkeeping: per-branch item tables (versionHistories.go) —
+    # branch axis B supports NDC divergent histories on device; linear
+    # histories use branch 0 only
     current_version: jnp.ndarray       # [W] i64
-    vh_event_ids: jnp.ndarray          # [W, Kv] i64 (PAD-filled)
-    vh_versions: jnp.ndarray           # [W, Kv] i64 (PAD-filled)
-    vh_count: jnp.ndarray              # [W] i32
+    vh_event_ids: jnp.ndarray          # [W, B, Kv] i64 (PAD-filled)
+    vh_versions: jnp.ndarray           # [W, B, Kv] i64 (PAD-filled)
+    vh_count: jnp.ndarray              # [W, B] i32
+    current_branch: jnp.ndarray        # [W] i32 (versionHistories.current_index)
     # pending tables
     activities: ActivityTable
     timers: TimerTable
@@ -147,6 +150,8 @@ class ErrorCode:
     TABLE_OVERFLOW = 10
     UNKNOWN_EVENT_TYPE = 11
     INVALID_BACKOFF_INITIATOR = 12
+    BRANCH_OVERFLOW = 13
+    BAD_FORK = 14
 
 
 def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:
@@ -163,6 +168,7 @@ def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> Re
     Ka, Kt = layout.max_activities, layout.max_timers
     Kc, Kr, Ks = layout.max_children, layout.max_request_cancels, layout.max_signals
     Kv = layout.max_version_history_items
+    B = layout.max_branches
 
     activities = ActivityTable(
         occ=zeros((W, Ka), BOOL),
@@ -220,9 +226,10 @@ def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> Re
         expiration_time=zeros((W,)),
         has_parent=zeros((W,), BOOL),
         current_version=full((W,), EMPTY_VERSION),
-        vh_event_ids=full((W, Kv), PAD),
-        vh_versions=full((W, Kv), PAD),
-        vh_count=zeros((W,), I32),
+        vh_event_ids=full((W, B, Kv), PAD),
+        vh_versions=full((W, B, Kv), PAD),
+        vh_count=zeros((W, B), I32),
+        current_branch=zeros((W,), I32),
         activities=activities,
         timers=timers,
         children=children,
@@ -230,3 +237,33 @@ def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> Re
         signals=signals,
         error=zeros((W,), I32),
     )
+
+
+def layout_of(s: ReplayState) -> PayloadLayout:
+    """Recover the PayloadLayout a state was built with (from array shapes)."""
+    return PayloadLayout(
+        max_version_history_items=s.vh_event_ids.shape[2],
+        max_activities=s.activities.occ.shape[1],
+        max_timers=s.timers.occ.shape[1],
+        max_children=s.children.occ.shape[1],
+        max_request_cancels=s.cancels.occ.shape[1],
+        max_signals=s.signals.occ.shape[1],
+        max_branches=s.vh_event_ids.shape[1],
+    )
+
+
+def reset_rows(s: ReplayState, mask: jnp.ndarray) -> ReplayState:
+    """Blend fresh init values into the rows where `mask` holds — the
+    continue-as-new run boundary (the reference builds a brand-new
+    mutableStateBuilder for the new run). The sticky error flag survives:
+    a chain whose earlier run corrupted stays flagged."""
+    import jax
+
+    fresh = init_state(s.state.shape[0], layout_of(s))
+
+    def blend(cur, new):
+        m = mask.reshape((-1,) + (1,) * (cur.ndim - 1))
+        return jnp.where(m, new, cur)
+
+    out = jax.tree_util.tree_map(blend, s, fresh)
+    return out._replace(error=s.error)

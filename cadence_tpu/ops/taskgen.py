@@ -35,10 +35,12 @@ from ..core.enums import (
     WorkflowBackoffTimeoutType,
 )
 from .encode import (
+    FLAG_VH_ONLY,
     LANE_A0,
     LANE_BATCH_LAST,
     LANE_EVENT_ID,
     LANE_EVENT_TYPE,
+    LANE_FLAGS,
     LANE_TIMESTAMP,
     LANE_VERSION,
 )
@@ -227,7 +229,11 @@ def step_tasks(s_new: ReplayState, ev: jnp.ndarray,
     batch_last = ev[:, LANE_BATCH_LAST]
     a = [ev[:, LANE_A0 + i] for i in range(8)]
 
-    ok = (ev_id > 0) & (s_new.error == 0)
+    # VH-only events (non-current-branch persists) generate no tasks: the
+    # reference persists them without running the task generator
+    # (ndc/transaction_manager.go passive persists)
+    vh_only = (ev[:, LANE_FLAGS] & FLAG_VH_ONLY) != 0
+    ok = (ev_id > 0) & (s_new.error == 0) & ~vh_only
 
     def m(t: EventType):
         return ok & (etype == int(t))

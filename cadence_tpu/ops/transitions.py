@@ -19,6 +19,7 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+from ..core.checksum import PAD
 from ..core.enums import (
     CLOSE_EVENT_STATUS,
     EMPTY_EVENT_ID,
@@ -29,16 +30,21 @@ from ..core.enums import (
     WorkflowState,
 )
 from .encode import (
+    FLAG_RUN_RESET,
+    FLAG_VH_ONLY,
     LANE_A0,
     LANE_BATCH_FIRST,
     LANE_BATCH_LAST,
+    LANE_BRANCH,
     LANE_EVENT_ID,
     LANE_EVENT_TYPE,
+    LANE_FLAGS,
+    LANE_PARENT,
     LANE_TASK_ID,
     LANE_TIMESTAMP,
     LANE_VERSION,
 )
-from .state import ErrorCode, ReplayState
+from .state import ErrorCode, ReplayState, reset_rows
 
 _I64 = jnp.int64
 
@@ -153,30 +159,96 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     task_id = ev[:, LANE_TASK_ID]
     batch_first = ev[:, LANE_BATCH_FIRST]
     batch_last = ev[:, LANE_BATCH_LAST]
+    branch = ev[:, LANE_BRANCH].astype(jnp.int32)
+    parent = ev[:, LANE_PARENT].astype(jnp.int32)
+    flags = ev[:, LANE_FLAGS]
     a = [ev[:, LANE_A0 + i] for i in range(8)]
 
+    # --- 0. continue-as-new run boundary: a FLAG_RUN_RESET event starts a
+    # fresh run in this row (the reference builds a brand-new
+    # mutableStateBuilder for newRunHistory); sticky errors survive the
+    # reset. lax.cond keeps the full-state blend off the hot path for the
+    # (typical) steps where no workflow crosses a run boundary.
+    import jax
+
+    do_reset = (ev_id > 0) & (s.error == 0) & ((flags & FLAG_RUN_RESET) != 0)
+    s = jax.lax.cond(do_reset.any(), lambda st: reset_rows(st, do_reset),
+                     lambda st: st, s)
+
     live = (ev_id > 0) & (s.error == 0)
+    vh_only = (flags & FLAG_VH_ONLY) != 0
     error = s.error
 
-    # --- 1. UpdateCurrentVersion(version, force=True)
-    # (mutable_state_builder.go:495-533; state_builder.go:112)
-    Kv = s.vh_event_ids.shape[1]
-    has_items = s.vh_count > 0
-    last_idx = jnp.maximum(s.vh_count - 1, 0)
+    # --- 1. per-branch version-history bookkeeping (versionHistories.go).
+    # The event targets branch `branch`; a branch receiving its FIRST item
+    # with parent != branch fork-inherits the parent's items truncated at
+    # this event's predecessor (DuplicateUntilLCAItem, versionHistory.go:136).
+    B = s.vh_event_ids.shape[1]
+    Kv = s.vh_event_ids.shape[2]
+    branch_over = live & (branch >= B)
+    error = _set_err(error, branch_over, ErrorCode.BRANCH_OVERFLOW)
+    live = live & ~branch_over
+    b = jnp.clip(branch, 0, B - 1)
+    p = jnp.clip(parent, 0, B - 1)
+
+    def gather_branch(arr, idx):
+        # arr [W, B, ...] → rows of branch idx [W, ...]
+        return jnp.take_along_axis(
+            arr, idx.astype(jnp.int32).reshape((-1, 1) + (1,) * (arr.ndim - 2)),
+            axis=1).squeeze(1)
+
+    b_ids = gather_branch(s.vh_event_ids, b)        # [W, Kv]
+    b_versions = gather_branch(s.vh_versions, b)    # [W, Kv]
+    b_count = gather_branch(s.vh_count[..., None], b).squeeze(-1)  # [W]
+    p_ids = gather_branch(s.vh_event_ids, p)
+    p_versions = gather_branch(s.vh_versions, p)
+    p_count = gather_branch(s.vh_count[..., None], p).squeeze(-1)
+
+    # fork-inherit: copy the parent's item prefix covering events < ev_id,
+    # capping the covering item at ev_id - 1 (the LCA event)
+    inherit = live & (b_count == 0) & (p != b)
+    lca_eid = ev_id - 1
+    slot = jnp.arange(Kv)[None, :]
+    prev_eid = jnp.concatenate(
+        [jnp.zeros((p_ids.shape[0], 1), p_ids.dtype), p_ids[:, :-1]], axis=1)
+    keep = (slot < p_count[:, None]) & (prev_eid < lca_eid[:, None])
+    # a fork below the parent's first item is host packing corruption
+    bad_fork = inherit & ((p_count == 0) | (lca_eid < 1))
+    error = _set_err(error, bad_fork, ErrorCode.BAD_FORK)
+    inherit = inherit & ~bad_fork
+    inh_ids = jnp.where(keep, jnp.minimum(p_ids, lca_eid[:, None]),
+                        jnp.int64(PAD))
+    inh_versions = jnp.where(keep, p_versions, jnp.int64(PAD))
+    inh_count = keep.sum(axis=1).astype(s.vh_count.dtype)
+    b_ids = jnp.where(inherit[:, None], inh_ids, b_ids)
+    b_versions = jnp.where(inherit[:, None], inh_versions, b_versions)
+    b_count = jnp.where(inherit, inh_count, b_count)
+    live = live & ~bad_fork
+
+    has_items = b_count > 0
+    last_idx = jnp.maximum(b_count - 1, 0)
     vh_last_onehot = jnp.arange(Kv)[None, :] == last_idx[:, None]
     vh_last_version = jnp.where(
         has_items,
-        jnp.where(vh_last_onehot, s.vh_versions, 0).sum(axis=1),
+        jnp.where(vh_last_onehot, b_versions, 0).sum(axis=1),
         jnp.int64(EMPTY_VERSION),
     )
     vh_last_event = jnp.where(
         has_items,
-        jnp.where(vh_last_onehot, s.vh_event_ids, 0).sum(axis=1),
+        jnp.where(vh_last_onehot, b_ids, 0).sum(axis=1),
         jnp.int64(EMPTY_EVENT_ID),
     )
-    completed = s.state == WorkflowState.Completed
-    current_version = _sel(live, jnp.where(completed, vh_last_version, ev_version),
-                           s.current_version)
+
+    # current branch's last version (for UpdateCurrentVersion on completed)
+    cur_versions = gather_branch(s.vh_versions, s.current_branch)
+    cur_count = gather_branch(s.vh_count[..., None], s.current_branch).squeeze(-1)
+    cur_last_idx = jnp.maximum(cur_count - 1, 0)
+    cur_last_onehot = jnp.arange(Kv)[None, :] == cur_last_idx[:, None]
+    cur_last_version = jnp.where(
+        cur_count > 0,
+        jnp.where(cur_last_onehot, cur_versions, 0).sum(axis=1),
+        jnp.int64(EMPTY_VERSION),
+    )
 
     # --- 2. version history AddOrUpdateItem(event.ID, event.Version)
     # (versionHistory.go:193-225; state_builder.go:115-128)
@@ -186,19 +258,41 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     error = _set_err(error, vh_order_bad, ErrorCode.VERSION_HISTORY_ORDER)
     vh_ok = live & ~vh_order_bad
     append = vh_ok & (~has_items | (ev_version > vh_last_version))
-    vh_overflow = append & (s.vh_count >= Kv)
+    vh_overflow = append & (b_count >= Kv)
     error = _set_err(error, vh_overflow, ErrorCode.VERSION_HISTORY_OVERFLOW)
     append_ok = append & ~vh_overflow
     update_last = vh_ok & has_items & (ev_version == vh_last_version)
-    onehot_append = (jnp.arange(Kv)[None, :] == s.vh_count[:, None]) & append_ok[:, None]
+    onehot_append = (jnp.arange(Kv)[None, :] == b_count[:, None]) & append_ok[:, None]
     onehot_update = vh_last_onehot & update_last[:, None]
     write = onehot_append | onehot_update
-    vh_event_ids = jnp.where(write, ev_id[:, None], s.vh_event_ids)
-    vh_versions = jnp.where(onehot_append, ev_version[:, None], s.vh_versions)
-    vh_count = s.vh_count + append_ok.astype(s.vh_count.dtype)
+    b_ids = jnp.where(write, ev_id[:, None], b_ids)
+    b_versions = jnp.where(onehot_append, ev_version[:, None], b_versions)
+    b_count = b_count + append_ok.astype(b_count.dtype)
 
-    # replay of this event proceeds only if version bookkeeping succeeded
+    # scatter branch b's updated table back into [W, B, Kv]
+    touched = live & (inherit | append_ok | update_last)
+    bsel = (jnp.arange(B)[None, :] == b[:, None]) & touched[:, None]  # [W, B]
+    vh_event_ids = jnp.where(bsel[:, :, None], b_ids[:, None, :], s.vh_event_ids)
+    vh_versions = jnp.where(bsel[:, :, None], b_versions[:, None, :], s.vh_versions)
+    vh_count = jnp.where(bsel, b_count[:, None], s.vh_count)
+
+    # --- 3. current-branch arbitration (conflict_resolver.go: a non-current
+    # branch whose head version overtakes the current branch's becomes
+    # current; state application for the winner's events is host-scheduled
+    # via FLAG_VH_ONLY, and this pointer is the device-side parity output)
     ok = vh_ok & ~vh_overflow
+    switch = ok & (b != s.current_branch) & (ev_version > cur_last_version)
+    current_branch = jnp.where(switch, b, s.current_branch)
+
+    # --- 4. UpdateCurrentVersion(version, force=True)
+    # (mutable_state_builder.go:495-533; state_builder.go:112)
+    completed = s.state == WorkflowState.Completed
+    current_version = _sel(live & ~vh_only,
+                           jnp.where(completed, cur_last_version, ev_version),
+                           s.current_version)
+
+    # state transitions below apply only to non-VH-only events
+    ok = ok & ~vh_only
 
     last_event_task_id = _sel(ok, task_id, s.last_event_task_id)
 
@@ -522,6 +616,7 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
         vh_event_ids=vh_event_ids,
         vh_versions=vh_versions,
         vh_count=vh_count,
+        current_branch=current_branch,
         activities=act,
         timers=tmr,
         children=ch,
