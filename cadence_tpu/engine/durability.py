@@ -177,6 +177,11 @@ def _repl_task_from(body: dict):
 class RecoveryReport:
     executions_rebuilt: int = 0
     open_workflows: int = 0
+    #: how many states were rebuilt by DEVICE replay + hydration vs the
+    #: oracle fallback (engine/rebuild.py) — the TPU engine is the primary
+    #: recovery rebuilder, not just the verifier
+    device_rebuilt: int = 0
+    rebuild_fallback: int = 0
     device_verified: int = 0
     oracle_fallback: int = 0
     divergent: List[Tuple[str, str, str]] = field(default_factory=list)
@@ -288,12 +293,16 @@ def _reconcile_current_pointers(stores: Stores) -> None:
 def _rebuild_executions(stores: Stores, verify_on_device: bool
                         ) -> RecoveryReport:
     from ..core.enums import WorkflowState
+    from ..oracle.mutable_state import DomainEntry
+    from .rebuild import DeviceRebuilder
+
     report = RecoveryReport()
-    for key in stores.history.list_runs():
+    keys = stores.history.list_runs()
+    jobs = []
+    for key in keys:
         domain_id = key[0]
         try:
             d = stores.domain.by_id(domain_id)
-            from ..oracle.mutable_state import DomainEntry
             entry = DomainEntry(domain_id=d.domain_id, name=d.name,
                                 is_active=d.is_active,
                                 retention_days=d.retention_days,
@@ -301,10 +310,19 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool
         except Exception:
             entry = None
         current_branch = stores.history.get_current_branch(*key)
-        batches = stores.history.as_history_batches(*key,
-                                                    branch=current_branch)
-        ms = StateBuilder(MutableState(entry)).replay_history(batches)
-        ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
+        jobs.append((stores.history.as_history_batches(
+            *key, branch=current_branch), entry))
+
+    # one batched device replay rebuilds EVERY run's state in lockstep
+    # (the bulk state_rebuilder); flagged rows fall back to the oracle,
+    # counted in the report
+    rebuilder = DeviceRebuilder()
+    states = rebuilder.rebuild(jobs) if jobs else []
+    report.device_rebuilt = rebuilder.stats.device
+    report.rebuild_fallback = rebuilder.stats.oracle_fallback
+
+    for key, ms in zip(keys, states):
+        current_branch = stores.history.get_current_branch(*key)
         # graft the OTHER branches' version histories (items derived from
         # their stored events) so NDC state survives recovery
         n_branches = stores.history.branch_count(*key)

@@ -112,6 +112,17 @@ class Frontend:
         self.router(workflow_id).terminate_workflow(domain_id, workflow_id,
                                                     run_id, reason)
 
+    def reset_workflow_execution(self, domain: str, workflow_id: str,
+                                 decision_finish_event_id: int,
+                                 run_id: Optional[str] = None,
+                                 reason: str = "") -> str:
+        """ResetWorkflowExecution (workflowHandler.go:2726): returns the new
+        run ID."""
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.router(workflow_id).reset_workflow(
+            domain_id, workflow_id, run_id,
+            decision_finish_event_id=decision_finish_event_id, reason=reason)
+
     # -- worker polls ------------------------------------------------------
 
     def poll_for_decision_task(self, domain: str, task_list: str

@@ -658,6 +658,102 @@ class HistoryEngine:
         txn.add(EventType.WorkflowExecutionTerminated, reason=reason)
         txn.commit(expected)
 
+    def reset_workflow(self, domain_id: str, workflow_id: str,
+                       run_id: Optional[str] = None, *,
+                       decision_finish_event_id: int,
+                       reason: str = "") -> str:
+        """ResetWorkflowExecution (historyEngine.go:2629 →
+        reset/resetter.go:96 replayResetWorkflow).
+
+        The base run's history is forked right before
+        `decision_finish_event_id` (the close of the decision being reset,
+        so the prefix ends with that decision in flight), the prefix is
+        rebuilt ON DEVICE into the new run's mutable state
+        (engine/rebuild.py — the stateRebuilder seat the reference fills
+        with a per-workflow Go replay), the in-flight decision is failed
+        with a reset cause, signals recorded after the reset point are
+        re-applied (ndc/events_reapplier.go), and the new run becomes
+        current; a still-running base run is terminated first."""
+        base_ms, _ = self._load(domain_id, workflow_id, run_id)
+        base_info = base_ms.execution_info
+        run_id = base_info.run_id
+        events = self.stores.history.read_events(domain_id, workflow_id, run_id)
+        prev = next((e for e in events
+                     if e.id == decision_finish_event_id - 1), None)
+        if prev is None or prev.event_type != EventType.DecisionTaskStarted:
+            # the reset point must be a decision boundary (resetter.go
+            # validateResetWorkflowBeforeReplay): the event before the
+            # finish ID is the decision's started event
+            raise InvalidRequestError(
+                "reset point must be the close of a decision: event "
+                f"{decision_finish_event_id - 1} is not a decision start")
+
+        new_run_id = str(uuid.uuid4())
+        prefix: List[HistoryBatch] = []
+        for b in self.stores.history.read_batches(domain_id, workflow_id,
+                                                  run_id):
+            keep = [e for e in b if e.id < decision_finish_event_id]
+            if keep:
+                prefix.append(HistoryBatch(
+                    domain_id=domain_id, workflow_id=workflow_id,
+                    run_id=new_run_id, events=keep))
+            if len(keep) < len(b):
+                break
+
+        # device-first rebuild of the forked prefix (oracle fallback counted)
+        from .rebuild import DeviceRebuilder
+        if not hasattr(self, "rebuilder"):
+            self.rebuilder = DeviceRebuilder()
+        new_ms = self.rebuilder.rebuild_one(prefix, self._domain_entry(domain_id))
+        new_ms.domain_entry = self._domain_entry(domain_id)
+
+        # terminate the base run while it still owns the current pointer
+        # (resetter terminateWorkflow; no-op when it already closed)
+        if base_info.state != WorkflowState.Completed:
+            self.terminate_workflow(domain_id, workflow_id, run_id,
+                                    reason=f"reset: {reason}")
+
+        # new-run events: fail the in-flight decision, re-apply post-reset
+        # signals, all in one batch continuing the forked event ids
+        txn = self._new_transaction(new_ms)
+        txn.add(EventType.DecisionTaskFailed,
+                scheduled_event_id=new_ms.execution_info.decision_schedule_id,
+                started_event_id=new_ms.execution_info.decision_started_id,
+                cause="reset-workflow", reason=reason)
+        for e in events:
+            if (e.id >= decision_finish_event_id
+                    and e.event_type == EventType.WorkflowExecutionSignaled):
+                txn.add(EventType.WorkflowExecutionSignaled, **dict(e.attrs))
+        batch = HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
+                             run_id=new_run_id, events=txn.events)
+        StateBuilder(new_ms).apply_batch(batch)
+        # the rebuilt state carries NO tasks (rebuilders discard them), so
+        # regenerate every dispatchable task — pending activities and
+        # timers forked into the prefix, the workflow-timeout timer, the
+        # transient decision — exactly the state-rebuild case the task
+        # refresher exists for (mutable_state_task_refresher.go:77)
+        from .task_refresher import refresh_tasks as _refresh
+        new_ms.transfer_tasks, new_ms.timer_tasks = [], []
+        new_ms.cross_cluster_tasks = []
+        events_by_id = {e.id: e for pb in prefix for e in pb.events}
+        events_by_id.update({e.id: e for e in txn.events})
+        _refresh(new_ms, events_by_id)
+        transfer = list(new_ms.transfer_tasks)
+        timer = list(new_ms.timer_tasks)
+        new_ms.transfer_tasks, new_ms.timer_tasks = [], []
+
+        self.shard.create_workflow(new_ms)
+        for pb in prefix:
+            self.stores.history.append_batch(domain_id, workflow_id,
+                                             new_run_id, pb.events)
+        self.stores.history.append_batch(domain_id, workflow_id, new_run_id,
+                                         txn.events)
+        self.shard.insert_tasks(domain_id, workflow_id, new_run_id,
+                                transfer, timer)
+        self._publish_replication(domain_id, workflow_id, new_run_id,
+                                  txn.events, new_ms)
+        return new_run_id
+
     # ------------------------------------------------------------------
     # Timer-queue callbacks (timer_active_task_executor.go analogs)
     # ------------------------------------------------------------------

@@ -39,7 +39,8 @@ class ReplicatedClusters:
                               cluster_name="standby", stores=standby_stores)
         self.publisher = ReplicationPublisher(self.active.stores)
         self.active.set_replication_publisher(self.publisher)
-        self.replicator = HistoryReplicator(self.standby.stores)
+        self.replicator = HistoryReplicator(self.standby.stores,
+                                            rebuilder=self.standby.rebuilder)
         self.processor = ReplicationTaskProcessor(
             self.replicator, self.publisher, self.standby.stores,
             source_history_reader=self._read_source_history)
@@ -48,7 +49,8 @@ class ReplicatedClusters:
         # remote cluster); needed for post-split-brain reconciliation
         self.reverse_publisher = ReplicationPublisher(self.standby.stores)
         self.standby.set_replication_publisher(self.reverse_publisher)
-        self.reverse_replicator = HistoryReplicator(self.active.stores)
+        self.reverse_replicator = HistoryReplicator(
+            self.active.stores, rebuilder=self.active.rebuilder)
         self.reverse_processor = ReplicationTaskProcessor(
             self.reverse_replicator, self.reverse_publisher,
             self.active.stores,

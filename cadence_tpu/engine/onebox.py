@@ -51,10 +51,18 @@ class Onebox:
         ]
         self.frontend = Frontend(self.stores, self.matching, self.route)
         self.tpu = TPUReplayEngine(self.stores)
+        # one device rebuilder shared by every engine this box creates and
+        # (via multicluster wiring) the replicator applying INTO this box,
+        # so box.rebuilder.stats counts that whole cluster's device vs
+        # oracle rebuilds; standalone recovery (durability.recover_stores)
+        # reports its own counts in RecoveryReport instead
+        from .rebuild import DeviceRebuilder
+        self.rebuilder = DeviceRebuilder()
 
     def _make_engine(self, shard) -> HistoryEngine:
         engine = HistoryEngine(shard, self.stores, self.clock)
         engine.replication_publisher_holder = self._publisher_holder
+        engine.rebuilder = self.rebuilder
         return engine
 
     def set_replication_publisher(self, publisher) -> None:

@@ -170,8 +170,16 @@ class HistoryReplicator:
     - contiguity per branch: dedup below the branch head,
       RetryReplicationError gaps for the resender."""
 
-    def __init__(self, stores: Stores) -> None:
+    def __init__(self, stores: Stores, rebuilder=None) -> None:
         self.stores = stores
+        # conflict-resolution rebuilds run on the accelerator with oracle
+        # fallback (engine/rebuild.py DeviceRebuilder; state_rebuilder.go
+        # bulk analog); pass the owning cluster's rebuilder so its stats
+        # aggregate cluster-wide, or let a standalone replicator own one
+        if rebuilder is None:
+            from .rebuild import DeviceRebuilder
+            rebuilder = DeviceRebuilder()
+        self.rebuilder = rebuilder
 
     def _load(self, task: ReplicationTask) -> Optional[MutableState]:
         """Always read the store: on an active cluster the local engine
@@ -359,9 +367,11 @@ class HistoryReplicator:
             else:
                 base = self.stores.history.as_history_batches(
                     *key, branch=branch_index)
-            rebuilt = StateBuilder(
-                MutableState(self._domain_entry(key[0]))).replay_history(
-                    base + list(batches))
+            # the winning branch's full lineage replays ON DEVICE; the
+            # hydrated state is payload-checked against the kernel's own
+            # canonical row, with oracle fallback counted by the rebuilder
+            rebuilt = self.rebuilder.rebuild_one(
+                base + list(batches), self._domain_entry(key[0]))
 
         # -- store mutations: nothing below raises on valid input ----------
         if fork_spec is not None:

@@ -57,15 +57,6 @@ class TPUReplayEngine:
             self.stores.history.as_history_batches(*key) for key in keys
         ]
 
-    def replay_payloads(self, keys: Sequence[Tuple[str, str, str]]
-                        ) -> Tuple[np.ndarray, np.ndarray]:
-        """Device-replay the given executions; returns (payload rows, errors)."""
-        from ..ops.replay import replay_corpus
-
-        histories = self._load_histories(keys)
-        rows, _crcs, errors = replay_corpus(histories, self.layout)
-        return rows, errors
-
     def tree_segments(self, key: Tuple[str, str, str]) -> list:
         """One run's full branch tree as encode_segments input: the current
         branch's lineage replays state-carrying; every other branch's

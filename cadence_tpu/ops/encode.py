@@ -128,6 +128,32 @@ def _encode_attrs(ev, interner: _Interner) -> List[int]:
     return a
 
 
+def _emit_events(out: np.ndarray, row: int, events, interner: _Interner,
+                 branch: int = 0, parent: int = 0, flags: int = 0,
+                 reset_first: bool = False) -> int:
+    """Pack one batch's events at `row`; the single lane-writing loop every
+    encoder shares. Returns the next free row."""
+    max_events = out.shape[0]
+    first_id = events[0].id
+    for j, ev in enumerate(events):
+        if row >= max_events:
+            raise OverflowError(f"history has more than {max_events} events")
+        out[row, LANE_EVENT_ID] = ev.id
+        out[row, LANE_EVENT_TYPE] = int(ev.event_type)
+        out[row, LANE_VERSION] = ev.version
+        out[row, LANE_TIMESTAMP] = ev.timestamp
+        out[row, LANE_TASK_ID] = ev.task_id
+        out[row, LANE_BATCH_FIRST] = first_id
+        out[row, LANE_BATCH_LAST] = 1 if j == len(events) - 1 else 0
+        out[row, LANE_A0:LANE_A0 + NUM_ATTR_LANES] = _encode_attrs(ev, interner)
+        out[row, LANE_BRANCH] = branch
+        out[row, LANE_PARENT] = parent
+        out[row, LANE_FLAGS] = (flags | FLAG_RUN_RESET
+                                if reset_first and j == 0 else flags)
+        row += 1
+    return row
+
+
 def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarray:
     """Pack one workflow's batched history into [E, L] lanes (zero-padded).
 
@@ -142,33 +168,13 @@ def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarr
     out[:, LANE_EVENT_TYPE] = -1
     interner = _Interner()
     row = 0
-
-    def emit(events, reset_first):
-        nonlocal row
-        first_id = events[0].id
-        for j, ev in enumerate(events):
-            if row >= max_events:
-                raise OverflowError(
-                    f"history has more than {max_events} events"
-                )
-            out[row, LANE_EVENT_ID] = ev.id
-            out[row, LANE_EVENT_TYPE] = int(ev.event_type)
-            out[row, LANE_VERSION] = ev.version
-            out[row, LANE_TIMESTAMP] = ev.timestamp
-            out[row, LANE_TASK_ID] = ev.task_id
-            out[row, LANE_BATCH_FIRST] = first_id
-            out[row, LANE_BATCH_LAST] = 1 if j == len(events) - 1 else 0
-            out[row, LANE_A0:LANE_A0 + NUM_ATTR_LANES] = _encode_attrs(ev, interner)
-            if reset_first and j == 0:
-                out[row, LANE_FLAGS] = FLAG_RUN_RESET
-            row += 1
-
     for batch in batches:
-        emit(batch.events, False)
+        row = _emit_events(out, row, batch.events, interner)
         if batch.new_run_events:
             # fresh interner: the new run's string IDs are a new namespace
             interner = _Interner()
-            emit(batch.new_run_events, True)
+            row = _emit_events(out, row, batch.new_run_events, interner,
+                               reset_first=True)
     return out
 
 
@@ -216,24 +222,8 @@ def encode_segments(segments: Sequence[tuple], max_events: int) -> np.ndarray:
                     "segment batch carries new_run_events; encode the "
                     "continued-as-new chain via encode_chain instead"
                 )
-            first_id = batch.events[0].id
-            for j, ev in enumerate(batch.events):
-                if row >= max_events:
-                    raise OverflowError(
-                        f"history has more than {max_events} events"
-                    )
-                out[row, LANE_EVENT_ID] = ev.id
-                out[row, LANE_EVENT_TYPE] = int(ev.event_type)
-                out[row, LANE_VERSION] = ev.version
-                out[row, LANE_TIMESTAMP] = ev.timestamp
-                out[row, LANE_TASK_ID] = ev.task_id
-                out[row, LANE_BATCH_FIRST] = first_id
-                out[row, LANE_BATCH_LAST] = 1 if j == len(batch.events) - 1 else 0
-                out[row, LANE_A0:LANE_A0 + NUM_ATTR_LANES] = _encode_attrs(ev, interner)
-                out[row, LANE_BRANCH] = branch
-                out[row, LANE_PARENT] = parent
-                out[row, LANE_FLAGS] = flags
-                row += 1
+            row = _emit_events(out, row, batch.events, interner,
+                               branch=branch, parent=parent, flags=flags)
     return out
 
 

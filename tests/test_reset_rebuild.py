@@ -1,0 +1,214 @@
+"""Workflow reset + device-backed rebuilds on the hot path.
+
+Round-3 VERDICT ask #2: the TPU engine must be the REBUILDER (not just the
+verifier) for reset (reset/resetter.go:96), NDC conflict resolution
+(conflict_resolver.go), and crash recovery (state_rebuilder.go) — asserted
+via the DeviceRebuilder counters.
+"""
+import pytest
+
+from cadence_tpu.core.checksum import payload_row
+from cadence_tpu.core.enums import CloseStatus, EventType, WorkflowState
+from cadence_tpu.engine.history_engine import InvalidRequestError
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider, SignalDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "reset-domain"
+TL = "reset-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _start_signal_workflow(box, wf="reset-1", expected=3):
+    box.frontend.start_workflow_execution(DOMAIN, wf, "signal", TL)
+    poller = TaskPoller(box, DOMAIN, TL, {wf: SignalDecider(expected_signals=expected)})
+    poller.drain()  # first decision completes, workflow waits on signals
+    domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+    run_id = box.stores.execution.get_current_run_id(domain_id, wf)
+    return poller, domain_id, run_id
+
+
+class TestReset:
+    def test_reset_forks_and_reapplies_signals(self, box):
+        poller, domain_id, run_id = _start_signal_workflow(box)
+        # two signals recorded after the first decision
+        box.frontend.signal_workflow_execution(DOMAIN, "reset-1", "s-1")
+        box.frontend.signal_workflow_execution(DOMAIN, "reset-1", "s-2")
+        poller.drain()
+
+        # reset to the close of the FIRST decision: history 1=started,
+        # 2=sched, 3=dt-started, 4=dt-completed → finish id 4
+        new_run = box.frontend.reset_workflow_execution(
+            DOMAIN, "reset-1", decision_finish_event_id=4, run_id=run_id,
+            reason="test")
+
+        # base run terminated, new run current
+        base = box.stores.execution.get_workflow(domain_id, "reset-1", run_id)
+        assert base.execution_info.close_status == CloseStatus.Terminated
+        assert box.stores.execution.get_current_run_id(
+            domain_id, "reset-1") == new_run
+
+        events = box.stores.history.read_events(domain_id, "reset-1", new_run)
+        kinds = [e.event_type for e in events]
+        # forked prefix ends with the in-flight decision; then the reset
+        # fails it and re-applies both signals
+        assert kinds[:3] == [EventType.WorkflowExecutionStarted,
+                             EventType.DecisionTaskScheduled,
+                             EventType.DecisionTaskStarted]
+        assert kinds[3] == EventType.DecisionTaskFailed
+        assert kinds.count(EventType.WorkflowExecutionSignaled) == 2
+        ms = box.stores.execution.get_workflow(domain_id, "reset-1", new_run)
+        assert ms.execution_info.signal_count == 2
+        assert ms.execution_info.state == WorkflowState.Running
+
+        # the prefix rebuild ran on DEVICE
+        assert box.rebuilder.stats.device >= 1
+        assert box.rebuilder.stats.oracle_fallback == 0
+
+    def test_reset_workflow_continues_to_completion(self, box):
+        poller, domain_id, run_id = _start_signal_workflow(box, wf="reset-2",
+                                                           expected=2)
+        box.frontend.signal_workflow_execution(DOMAIN, "reset-2", "s-1")
+        poller.drain()
+        new_run = box.frontend.reset_workflow_execution(
+            DOMAIN, "reset-2", decision_finish_event_id=4, run_id=run_id)
+
+        # the transient decision dispatches; the decider sees the single
+        # reapplied signal and needs one more to close
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"reset-2": SignalDecider(expected_signals=2)})
+        poller.drain()
+        box.frontend.signal_workflow_execution(DOMAIN, "reset-2", "s-2")
+        poller.drain()
+        ms = box.stores.execution.get_workflow(domain_id, "reset-2", new_run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+        result = box.tpu.verify_all()
+        assert result.ok
+
+    def test_reset_redispatches_pending_activity(self, box):
+        """A pending (scheduled, un-started) activity forked into the
+        prefix must be redispatched in the new run: reset regenerates all
+        tasks via the refresher (the rebuilt state carries none)."""
+        from cadence_tpu.models.deciders import EchoDecider
+
+        box.frontend.start_workflow_execution(DOMAIN, "reset-act", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"reset-act": EchoDecider(TL)})
+        # decisions ONLY (no activity polls): decision 1 schedules the
+        # activity, which stays pending; a signal forces decision 2 so the
+        # reset point lands past the activity-scheduled event
+        box.pump_once()
+        while poller.poll_and_decide_once():
+            box.pump_once()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "reset-act")
+        box.frontend.signal_workflow_execution(DOMAIN, "reset-act", "nudge")
+        box.pump_once()
+        while poller.poll_and_decide_once():
+            box.pump_once()
+        events = box.stores.history.read_events(domain_id, "reset-act", run_id)
+        finish = max(e.id for e in events
+                     if e.event_type == EventType.DecisionTaskCompleted)
+        new_run = box.frontend.reset_workflow_execution(
+            DOMAIN, "reset-act", decision_finish_event_id=finish, run_id=run_id)
+
+        # the forked prefix still holds the pending activity, and the
+        # activity task was re-inserted: the poller can run it to done
+        ms = box.stores.execution.get_workflow(domain_id, "reset-act", new_run)
+        assert len(ms.pending_activity_info_ids) == 1
+        poller = TaskPoller(box, DOMAIN, TL, {"reset-act": EchoDecider(TL)})
+        poller.drain()
+        ms = box.stores.execution.get_workflow(domain_id, "reset-act", new_run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_reset_rejects_non_decision_boundary(self, box):
+        poller, domain_id, run_id = _start_signal_workflow(box, wf="reset-3")
+        with pytest.raises(InvalidRequestError):
+            box.frontend.reset_workflow_execution(
+                DOMAIN, "reset-3", decision_finish_event_id=3, run_id=run_id)
+
+    def test_reset_closed_workflow(self, box):
+        """Resetting an already-closed run: no terminate, new run current."""
+        box.frontend.start_workflow_execution(DOMAIN, "reset-4", "t", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"reset-4": CompleteDecider()})
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "reset-4")
+        base = box.stores.execution.get_workflow(domain_id, "reset-4", run_id)
+        assert base.execution_info.state == WorkflowState.Completed
+
+        new_run = box.frontend.reset_workflow_execution(
+            DOMAIN, "reset-4", decision_finish_event_id=4, run_id=run_id)
+        ms = box.stores.execution.get_workflow(domain_id, "reset-4", new_run)
+        assert ms.execution_info.state == WorkflowState.Running
+        assert box.stores.execution.get_current_run_id(
+            domain_id, "reset-4") == new_run
+        # base run unchanged (still completed, not terminated)
+        base = box.stores.execution.get_workflow(domain_id, "reset-4", run_id)
+        assert base.execution_info.close_status == CloseStatus.Completed
+
+
+class TestDeviceRebuildHotPath:
+    def test_recovery_rebuilds_on_device(self, tmp_path):
+        """Crash recovery rebuilds every run's state via batched device
+        replay (report.device_rebuilt), oracle fallback only when flagged."""
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            recover_stores,
+        )
+
+        path = str(tmp_path / "wal.log")
+        stores = open_durable_stores(path)
+        box = Onebox(num_hosts=1, num_shards=4, stores=stores)
+        box.frontend.register_domain(DOMAIN)
+        for i in range(4):
+            box.frontend.start_workflow_execution(DOMAIN, f"wf-{i}", "t", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {f"wf-{i}": CompleteDecider() for i in range(4)})
+        poller.drain()
+
+        recovered, report = recover_stores(path)
+        assert report.executions_rebuilt == 4
+        assert report.device_rebuilt == 4
+        assert report.rebuild_fallback == 0
+        assert report.ok
+
+    def test_ndc_conflict_rebuild_on_device(self):
+        """The winning-branch rebuild in conflict resolution runs through
+        the device rebuilder."""
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+
+        clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
+        clusters.register_global_domain(DOMAIN)
+        box = clusters.active
+        box.frontend.start_workflow_execution(DOMAIN, "split", "signal", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"split": SignalDecider(expected_signals=2)})
+        poller.drain()
+        clusters.replicate()
+        clusters.split_brain_promote(DOMAIN)
+        apoller = TaskPoller(clusters.active, DOMAIN, TL,
+                             {"split": SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution(DOMAIN, "split", "a")
+        apoller.drain()
+        spoller = TaskPoller(clusters.standby, DOMAIN, TL,
+                             {"split": SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, "split", "b1")
+        clusters.standby.frontend.signal_workflow_execution(DOMAIN, "split", "b2")
+        spoller.drain()
+        clusters.heal(DOMAIN, "standby")
+
+        # the conflict was resolved by device-replaying the winning branch
+        replicators = [clusters.replicator, clusters.reverse_replicator]
+        device = sum(r.rebuilder.stats.device for r in replicators)
+        fallback = sum(r.rebuilder.stats.oracle_fallback for r in replicators)
+        assert device >= 1
+        assert fallback == 0
+        for b in (clusters.active, clusters.standby):
+            assert b.tpu.verify_all().ok
