@@ -75,6 +75,13 @@ class PayloadLayout:
 
 DEFAULT_LAYOUT = PayloadLayout()
 
+#: row index of the sticky-task-list hash. Replay always clears stickyness
+#: (state_builder.go:108), so device-replayed rows carry 0 here while a live
+#: ACTIVE state may legitimately hold a sticky hash — live-vs-replay
+#: comparisons mask this field (the reference never replay-derives it
+#: either: its checksum is only compared against the same stored state).
+STICKY_ROW_INDEX = 10
+
 
 def fnv64(s: str) -> int:
     """FNV-1a 64-bit hash, wrapped to signed int64; 0 for the empty string."""

@@ -148,9 +148,13 @@ class Frontend:
             previous_started_event_id=ms.execution_info.last_processed_event)
 
     def respond_decision_task_completed(self, token: TaskToken,
-                                        decisions: List[Decision]) -> None:
+                                        decisions: List[Decision],
+                                        sticky_task_list: str = "",
+                                        sticky_schedule_to_start_timeout: int = 0
+                                        ) -> None:
         self.router(token.workflow_id).respond_decision_task_completed(
-            token, decisions)
+            token, decisions, sticky_task_list=sticky_task_list,
+            sticky_schedule_to_start_timeout=sticky_schedule_to_start_timeout)
 
     def poll_for_activity_task(self, domain: str, task_list: str
                                ) -> Optional[PollActivityResponse]:

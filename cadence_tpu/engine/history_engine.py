@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.checksum import Checksum
 from ..core.enums import (
+    BUFFERED_EVENT_ID,
     EMPTY_EVENT_ID,
     TRANSIENT_EVENT_ID,
     CloseStatus,
@@ -156,6 +157,86 @@ class HistoryEngine:
         return _Txn(self, ms)
 
     # ------------------------------------------------------------------
+    # Buffered events (mutable_state_builder.go:112-114 bufferedEvents;
+    # FlushBufferedEvents :415): while a decision is IN FLIGHT (started,
+    # not closed), externally-caused events are buffered in mutable state
+    # with no history IDs; at decision close they flush — IDs assigned
+    # after the close event, activity/child COMPLETION events reordered to
+    # the back (reorderBuffer) so their started counterparts precede them.
+    # ------------------------------------------------------------------
+
+    #: completion events moved to the back of the flush (reorderBuffer)
+    _REORDER_TYPES = frozenset({
+        EventType.ActivityTaskCompleted, EventType.ActivityTaskFailed,
+        EventType.ActivityTaskTimedOut, EventType.ActivityTaskCanceled,
+        EventType.ChildWorkflowExecutionCompleted,
+        EventType.ChildWorkflowExecutionFailed,
+        EventType.ChildWorkflowExecutionTimedOut,
+        EventType.ChildWorkflowExecutionTerminated,
+        EventType.ChildWorkflowExecutionCanceled,
+    })
+    _ACTIVITY_CLOSE_TYPES = frozenset({
+        EventType.ActivityTaskCompleted, EventType.ActivityTaskFailed,
+        EventType.ActivityTaskTimedOut, EventType.ActivityTaskCanceled,
+    })
+
+    @staticmethod
+    def _has_inflight_decision(ms: MutableState) -> bool:
+        return ms.execution_info.decision_started_id != EMPTY_EVENT_ID
+
+    def _buffer_event(self, ms: MutableState, expected: int,
+                      event_type: EventType, **attrs: Any) -> None:
+        """Append one buffered event and persist state WITHOUT appending
+        history (the updateBufferedEvents arm of CloseTransaction). Runs
+        the timer sequence like every transaction close, so e.g. a
+        buffered activity start still creates its timeout timers."""
+        ms.buffered_events.append(HistoryEvent(
+            id=BUFFERED_EVENT_ID, event_type=event_type,
+            version=ms.domain_entry.failover_version,
+            timestamp=self.clock.now(), attrs=attrs))
+        self._commit_transient(ms, expected)
+
+    def _buffered_close_exists(self, ms: MutableState, **match: Any) -> bool:
+        """True when a buffered event already closes the same entity (the
+        pending-info maps don't shrink until flush, so double-respond
+        validation must consult the buffer too)."""
+        for ev in ms.buffered_events:
+            if all(ev.get(k) == v for k, v in match.items()):
+                if ev.event_type in self._REORDER_TYPES or ev.event_type in (
+                        EventType.TimerFired, EventType.TimerCanceled):
+                    return True
+        return False
+
+    def _flush_buffered(self, txn: "_Txn", ms: MutableState) -> int:
+        """Assign real event IDs to the buffer, completion events last;
+        started-event references recorded as BUFFERED_EVENT_ID are patched
+        to the flushed IDs (the reference's buffered-event-ID scrubbing)."""
+        if not ms.buffered_events:
+            return 0
+        normal = [e for e in ms.buffered_events
+                  if e.event_type not in self._REORDER_TYPES]
+        closes = [e for e in ms.buffered_events
+                  if e.event_type in self._REORDER_TYPES]
+        ms.buffered_events = []
+        flushed_started: Dict[int, int] = {}
+        flushed_child_started: Dict[int, int] = {}
+        for ev in normal + closes:
+            attrs = dict(ev.attrs)
+            if attrs.get("started_event_id") == BUFFERED_EVENT_ID:
+                if ev.event_type in self._ACTIVITY_CLOSE_TYPES:
+                    attrs["started_event_id"] = flushed_started.get(
+                        attrs.get("scheduled_event_id"), BUFFERED_EVENT_ID)
+                else:  # child close: link to the flushed child started
+                    attrs["started_event_id"] = flushed_child_started.get(
+                        attrs.get("initiated_event_id"), BUFFERED_EVENT_ID)
+            real = txn.add_flushed(ev, attrs)
+            if ev.event_type == EventType.ActivityTaskStarted:
+                flushed_started[attrs.get("scheduled_event_id")] = real.id
+            elif ev.event_type == EventType.ChildWorkflowExecutionStarted:
+                flushed_child_started[attrs.get("initiated_event_id")] = real.id
+        return len(normal) + len(closes)
+
+    # ------------------------------------------------------------------
     # StartWorkflowExecution (historyEngine.go:547, startWorkflowHelper:583)
     # ------------------------------------------------------------------
 
@@ -272,11 +353,33 @@ class HistoryEngine:
                          run_id=run_id, schedule_id=schedule_id,
                          started_id=started.id)
 
+    #: decisions that close the workflow (UnhandledDecision check)
+    _CLOSE_DECISIONS = frozenset({
+        DecisionType.CompleteWorkflowExecution,
+        DecisionType.FailWorkflowExecution,
+        DecisionType.CancelWorkflowExecution,
+        DecisionType.ContinueAsNewWorkflowExecution,
+    })
+
     def respond_decision_task_completed(self, token: TaskToken,
-                                        decisions: List[Decision]) -> None:
+                                        decisions: List[Decision],
+                                        sticky_task_list: str = "",
+                                        sticky_schedule_to_start_timeout: int = 0
+                                        ) -> None:
         """RespondDecisionTaskCompleted (historyEngine.go:1787 →
         decision/handler.go:285, per-decision translation per
-        decision/task_handler.go)."""
+        decision/task_handler.go).
+
+        Buffered events: a close decision racing buffered events fails
+        with UNHANDLED_DECISION so the worker re-decides with the new
+        events visible (historyEngine.go hasUnhandledEventsBeforeDecision);
+        otherwise the buffer flushes right behind the completed event and,
+        when anything flushed, a fresh decision is scheduled.
+
+        Sticky execution: StickyAttributes on the response pin the next
+        decision dispatch to the worker's sticky task list; absent
+        attributes clear stickyness (workflowHandler →
+        historyEngine.go RespondDecisionTaskCompleted sticky handling)."""
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
         info = ms.execution_info
         if info.state == WorkflowState.Completed:
@@ -284,6 +387,31 @@ class HistoryEngine:
         if (info.decision_schedule_id != token.schedule_id
                 or info.decision_started_id != token.started_id):
             raise InvalidRequestError("decision task no longer current")
+
+        if ms.buffered_events and any(d.decision_type in self._CLOSE_DECISIONS
+                                      for d in decisions):
+            # UnhandledDecision: the close must not race the buffer; the
+            # flushed events force a REAL follow-up decision (attempt 0,
+            # mutable_state_decision_task_manager.go:373-382)
+            txn = self._new_transaction(ms)
+            txn.add(EventType.DecisionTaskFailed,
+                    scheduled_event_id=token.schedule_id,
+                    started_event_id=token.started_id,
+                    cause="UNHANDLED_DECISION")
+            self._flush_buffered(txn, ms)
+            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
+            txn.commit(expected)
+            return
+
+        if sticky_task_list:
+            info.sticky_task_list = sticky_task_list
+            info.sticky_schedule_to_start_timeout = (
+                sticky_schedule_to_start_timeout)
+        else:
+            ms.clear_stickyness()
+
         txn = self._new_transaction(ms)
         completed = txn.add(EventType.DecisionTaskCompleted,
                             scheduled_event_id=token.schedule_id,
@@ -293,6 +421,20 @@ class HistoryEngine:
             closed = self._apply_decision(txn, ms, completed.id, d) or closed
             if closed:
                 break
+        # buffered events flush at transaction close, BEHIND the decision's
+        # command events (FlushBufferedEvents runs in CloseTransaction,
+        # mutable_state_builder.go:4150); a close decision cannot reach
+        # here with a non-empty buffer (UnhandledDecision above)
+        flushed = self._flush_buffered(txn, ms)
+        if flushed and not closed:
+            # the flushed events need a decision to process them (the
+            # completed event above clears the pending decision, so this
+            # schedules unconditionally — hasUnhandledEvents arm of
+            # historyEngine RespondDecisionTaskCompleted)
+            txn.add(EventType.DecisionTaskScheduled,
+                    task_list=info.sticky_task_list or info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
         txn.commit(expected)
         # continue-as-new chaining is handled inside _apply_decision
 
@@ -323,6 +465,14 @@ class HistoryEngine:
             if a.get("timer_id") not in ms.pending_timer_info_ids:
                 raise InvalidRequestError(f"unknown timer {a.get('timer_id')}")
             ti = ms.pending_timer_info_ids[a["timer_id"]]
+            # a fire buffered behind this decision loses to the cancel: the
+            # buffered TimerFired is scrubbed so the flush doesn't replay a
+            # fire for a timer the cancel deletes (checkAndClearTimerFiredEvent,
+            # mutable_state_builder.go:588-604)
+            ms.buffered_events = [
+                e for e in ms.buffered_events
+                if not (e.event_type == EventType.TimerFired
+                        and e.get("timer_id") == a["timer_id"])]
             txn.add(EventType.TimerCanceled, timer_id=a["timer_id"],
                     started_event_id=ti.started_id,
                     decision_task_completed_event_id=completed_id)
@@ -498,12 +648,22 @@ class HistoryEngine:
         )
 
     def fail_decision_task(self, token: TaskToken, cause: str) -> None:
-        """RespondDecisionTaskFailed path."""
+        """RespondDecisionTaskFailed path.
+
+        With buffered events, the follow-up decision cannot be a transient
+        (its provisional schedule ID would collide with the flushed events'
+        IDs — mutable_state_decision_task_manager.go:373-382), so the
+        buffer flushes and a REAL scheduled event follows with attempt 0."""
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
+        info = ms.execution_info
         txn = self._new_transaction(ms)
         txn.add(EventType.DecisionTaskFailed,
                 scheduled_event_id=token.schedule_id,
                 started_event_id=token.started_id, cause=cause)
+        if self._flush_buffered(txn, ms):
+            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
         txn.commit(expected)
 
     # ------------------------------------------------------------------
@@ -540,6 +700,22 @@ class HistoryEngine:
                              run_id=run_id, schedule_id=schedule_id,
                              started_id=TRANSIENT_EVENT_ID,
                              attempt=ai.attempt)
+        if self._has_inflight_decision(ms):
+            # the started event buffers (mutable_state_builder.go:2218
+            # hasPendingDecision arm): state records the start immediately
+            # with the buffered sentinel; the real ID lands at flush
+            now = self.clock.now()
+            ai.version = ms.current_version
+            ai.started_id = BUFFERED_EVENT_ID
+            ai.request_id = request_id
+            ai.started_time = now
+            ai.last_heartbeat_updated_time = now
+            self._buffer_event(ms, expected, EventType.ActivityTaskStarted,
+                               scheduled_event_id=schedule_id,
+                               request_id=request_id)
+            return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
+                             run_id=run_id, schedule_id=schedule_id,
+                             started_id=BUFFERED_EVENT_ID)
         txn = self._new_transaction(ms)
         started = txn.add(EventType.ActivityTaskStarted,
                           scheduled_event_id=schedule_id, request_id=request_id)
@@ -547,6 +723,23 @@ class HistoryEngine:
         return TaskToken(domain_id=domain_id, workflow_id=workflow_id,
                          run_id=run_id, schedule_id=schedule_id,
                          started_id=started.id)
+
+    def _buffer_transient_started(self, ms: MutableState, ai,
+                                  schedule_id: int) -> None:
+        """Move a TRANSIENT activity start into the buffer (the activity is
+        closing while a decision is in flight, so its deferred started
+        event buffers ahead of the close)."""
+        if ai.started_id != TRANSIENT_EVENT_ID:
+            return
+        ms.buffered_events.append(HistoryEvent(
+            id=BUFFERED_EVENT_ID,
+            event_type=EventType.ActivityTaskStarted,
+            version=ms.domain_entry.failover_version,
+            timestamp=ai.started_time or self.clock.now(),
+            attrs=dict(scheduled_event_id=schedule_id,
+                       attempt=ai.attempt, request_id=ai.request_id,
+                       last_failure_reason=ai.last_failure_reason)))
+        ai.started_id = BUFFERED_EVENT_ID
 
     @staticmethod
     def _flush_transient_started(txn: "_Txn", ms: MutableState,
@@ -575,16 +768,32 @@ class HistoryEngine:
         if ms.execution_info.state == WorkflowState.Completed:
             raise InvalidRequestError("workflow execution already completed")
         ai = ms.pending_activity_info_ids.get(token.schedule_id)
-        if (ai is None or ai.started_id != token.started_id
-                or ai.attempt != token.attempt):
+        # a token minted while the start was buffered carries the sentinel
+        # and stays valid after the flush gave the start its real ID
+        started_matches = ai is not None and (
+            ai.started_id == token.started_id
+            or (token.started_id == BUFFERED_EVENT_ID and ai.started_id > 0))
+        if (ai is None or not started_matches
+                or ai.attempt != token.attempt
+                or self._buffered_close_exists(
+                    ms, scheduled_event_id=token.schedule_id)):
             raise InvalidRequestError("activity task no longer current")
         if try_retry and retry_activity(ms, ai, self.clock.now(),
                                         extra.get("reason", "")):
             self._commit_transient(ms, expected)
             self._publish_sync_activity(ms, ai)
             return
+        if self._has_inflight_decision(ms):
+            # close buffers behind the running decision; a transient start
+            # (retry-policy activity) buffers its deferred started event
+            # first so the flush order start→close holds
+            self._buffer_transient_started(ms, ai, token.schedule_id)
+            self._buffer_event(ms, expected, close_type,
+                               scheduled_event_id=token.schedule_id,
+                               started_event_id=ai.started_id, **extra)
+            return
         txn = self._new_transaction(ms)
-        started_id = token.started_id
+        started_id = ai.started_id
         transient = self._flush_transient_started(txn, ms, token.schedule_id)
         if transient is not None:
             started_id = transient.id
@@ -632,6 +841,12 @@ class HistoryEngine:
                         signal_name: str, run_id: Optional[str] = None) -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
+        if self._has_inflight_decision(ms):
+            # buffered until the in-flight decision closes; no new decision
+            # scheduled (one is already running)
+            self._buffer_event(ms, expected, EventType.WorkflowExecutionSignaled,
+                               signal_name=signal_name)
+            return
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionSignaled, signal_name=signal_name)
         self._maybe_schedule_decision(txn, ms)
@@ -642,8 +857,15 @@ class HistoryEngine:
                                 cause: str = "") -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
-        if ms.execution_info.cancel_requested:
+        if ms.execution_info.cancel_requested or any(
+                e.event_type == EventType.WorkflowExecutionCancelRequested
+                for e in ms.buffered_events):
             raise InvalidRequestError("cancellation already requested")
+        if self._has_inflight_decision(ms):
+            self._buffer_event(ms, expected,
+                               EventType.WorkflowExecutionCancelRequested,
+                               cause=cause)
+            return
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionCancelRequested, cause=cause)
         self._maybe_schedule_decision(txn, ms)
@@ -654,6 +876,9 @@ class HistoryEngine:
                            reason: str = "") -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
+        # a force-close discards the buffer (the reference drops buffered
+        # events when the workflow closes without a decision to flush them)
+        ms.buffered_events = []
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionTerminated, reason=reason)
         txn.commit(expected)
@@ -766,6 +991,13 @@ class HistoryEngine:
         timer_id = ms.pending_timer_event_id_to_id.get(started_event_id)
         if timer_id is None:
             return  # already fired/canceled
+        if self._buffered_close_exists(ms, timer_id=timer_id):
+            return  # fired while buffered; pending until flush
+        if self._has_inflight_decision(ms):
+            self._buffer_event(ms, expected, EventType.TimerFired,
+                               timer_id=timer_id,
+                               started_event_id=started_event_id)
+            return
         txn = self._new_transaction(ms)
         txn.add(EventType.TimerFired, timer_id=timer_id,
                 started_event_id=started_event_id)
@@ -799,6 +1031,15 @@ class HistoryEngine:
                 self._commit_transient(ms, expected)
                 self._publish_sync_activity(ms, ai)
                 return
+        if self._buffered_close_exists(ms, scheduled_event_id=schedule_id):
+            return
+        if self._has_inflight_decision(ms):
+            self._buffer_transient_started(ms, ai, schedule_id)
+            self._buffer_event(ms, expected, EventType.ActivityTaskTimedOut,
+                               scheduled_event_id=schedule_id,
+                               started_event_id=ai.started_id,
+                               timeout_type=int(tt))
+            return
         txn = self._new_transaction(ms)
         started_id = ai.started_id
         transient = self._flush_transient_started(txn, ms, schedule_id)
@@ -817,16 +1058,42 @@ class HistoryEngine:
             return
         if info.decision_schedule_id != schedule_id:
             return  # decision already completed
+        tt = TimeoutType(timeout_type)
         txn = self._new_transaction(ms)
+        if tt == TimeoutType.ScheduleToStart:
+            # the sticky dispatch deadline (timer_active_task_executor
+            # handleDecisionTimeout SCHEDULE_TO_START arm): only meaningful
+            # while the decision is still unstarted; the attempt does NOT
+            # increment (no transient), stickiness clears, and an explicit
+            # scheduled event re-dispatches on the NORMAL task list
+            if info.decision_started_id != EMPTY_EVENT_ID:
+                return  # started in the meantime: deadline no longer applies
+            txn.add(EventType.DecisionTaskTimedOut,
+                    scheduled_event_id=schedule_id,
+                    started_event_id=EMPTY_EVENT_ID,
+                    timeout_type=int(tt))
+            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
+            txn.commit(expected)
+            return
         txn.add(EventType.DecisionTaskTimedOut, scheduled_event_id=schedule_id,
                 started_event_id=info.decision_started_id,
                 timeout_type=timeout_type)
+        # the timed-out decision's buffer flushes behind the close event;
+        # like the failed path, flushed events force a REAL follow-up
+        # decision instead of a transient (:373-382)
+        if self._flush_buffered(txn, ms):
+            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
         txn.commit(expected)
 
     def timeout_workflow(self, domain_id: str, workflow_id: str, run_id: str) -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             return
+        ms.buffered_events = []  # force-close discards the buffer
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionTimedOut)
         txn.commit(expected)
@@ -853,7 +1120,18 @@ class HistoryEngine:
     def on_child_started(self, domain_id: str, workflow_id: str, run_id: str,
                          initiated_id: int, child_run_id: str) -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
-        if initiated_id not in ms.pending_child_execution_info_ids:
+        ci = ms.pending_child_execution_info_ids.get(initiated_id)
+        if ci is None or ci.started_id != EMPTY_EVENT_ID:
+            return  # unknown or already started (redelivered transfer task)
+        if self._has_inflight_decision(ms):
+            # record the start in state now (the buffered sentinel keeps
+            # the close linkage patchable at flush, like activity starts)
+            ci.started_id = BUFFERED_EVENT_ID
+            ci.started_run_id = child_run_id
+            self._buffer_event(ms, expected,
+                               EventType.ChildWorkflowExecutionStarted,
+                               initiated_event_id=initiated_id,
+                               run_id=child_run_id)
             return
         txn = self._new_transaction(ms)
         txn.add(EventType.ChildWorkflowExecutionStarted,
@@ -865,6 +1143,13 @@ class HistoryEngine:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         ci = ms.pending_child_execution_info_ids.get(initiated_id)
         if ci is None or ms.execution_info.state == WorkflowState.Completed:
+            return
+        if self._buffered_close_exists(ms, initiated_event_id=initiated_id):
+            return
+        if self._has_inflight_decision(ms):
+            self._buffer_event(ms, expected, close_event_type,
+                               initiated_event_id=initiated_id,
+                               started_event_id=ci.started_id)
             return
         txn = self._new_transaction(ms)
         txn.add(close_event_type, initiated_event_id=initiated_id,
@@ -878,10 +1163,16 @@ class HistoryEngine:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if initiated_id not in ms.pending_signal_info_ids:
             return
+        et = (EventType.SignalExternalWorkflowExecutionFailed if failed
+              else EventType.ExternalWorkflowExecutionSignaled)
+        if self._has_inflight_decision(ms):
+            if not any(e.get("initiated_event_id") == initiated_id
+                       for e in ms.buffered_events):
+                self._buffer_event(ms, expected, et,
+                                   initiated_event_id=initiated_id)
+            return
         txn = self._new_transaction(ms)
-        txn.add(EventType.SignalExternalWorkflowExecutionFailed if failed
-                else EventType.ExternalWorkflowExecutionSignaled,
-                initiated_event_id=initiated_id)
+        txn.add(et, initiated_event_id=initiated_id)
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
 
@@ -891,10 +1182,16 @@ class HistoryEngine:
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if initiated_id not in ms.pending_request_cancel_info_ids:
             return
+        et = (EventType.RequestCancelExternalWorkflowExecutionFailed if failed
+              else EventType.ExternalWorkflowExecutionCancelRequested)
+        if self._has_inflight_decision(ms):
+            if not any(e.get("initiated_event_id") == initiated_id
+                       for e in ms.buffered_events):
+                self._buffer_event(ms, expected, et,
+                                   initiated_event_id=initiated_id)
+            return
         txn = self._new_transaction(ms)
-        txn.add(EventType.RequestCancelExternalWorkflowExecutionFailed if failed
-                else EventType.ExternalWorkflowExecutionCancelRequested,
-                initiated_event_id=initiated_id)
+        txn.add(et, initiated_event_id=initiated_id)
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
 
@@ -953,10 +1250,13 @@ class HistoryEngine:
     @staticmethod
     def _maybe_schedule_decision(txn: "_Txn", ms: MutableState) -> None:
         """Schedule a decision when none is pending (the signal/timer/activity
-        completion paths all do this, e.g. historyEngine signal path)."""
+        completion paths all do this, e.g. historyEngine signal path). A
+        sticky task list pins dispatch to the worker that completed the last
+        decision (mutable_state_decision_task_manager.go:384-390)."""
         info = ms.execution_info
         if info.decision_schedule_id == EMPTY_EVENT_ID:
-            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
+            txn.add(EventType.DecisionTaskScheduled,
+                    task_list=info.sticky_task_list or info.task_list,
                     start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
                     attempt=0)
 
@@ -986,6 +1286,19 @@ class _Txn:
         self.events.append(ev)
         return ev
 
+    def add_flushed(self, buffered: HistoryEvent,
+                    attrs: Dict[str, Any]) -> HistoryEvent:
+        """Assign a real ID to a buffered event, preserving its original
+        version and timestamp (FlushBufferedEvents reassigns IDs only)."""
+        ev = HistoryEvent(
+            id=self._next_id, event_type=buffered.event_type,
+            version=buffered.version, timestamp=buffered.timestamp,
+            attrs=attrs,
+        )
+        self._next_id += 1
+        self.events.append(ev)
+        return ev
+
     def after_commit(self, fn) -> None:
         self._post.append(fn)
 
@@ -996,7 +1309,9 @@ class _Txn:
         batch = HistoryBatch(domain_id=info.domain_id,
                              workflow_id=info.workflow_id,
                              run_id=info.run_id, events=self.events)
-        StateBuilder(self.ms).apply_batch(batch)
+        # active transactions keep sticky execution state; only the true
+        # replay paths clear it (state_builder.go:108)
+        StateBuilder(self.ms, clear_sticky=False).apply_batch(batch)
         new_transfer = list(self.ms.transfer_tasks)
         new_timer = list(self.ms.timer_tasks)
         # tasks are drained into the shard queues at commit; the persisted

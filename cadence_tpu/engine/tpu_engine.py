@@ -26,7 +26,12 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout, payload_row
+from ..core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    PayloadLayout,
+    payload_row,
+)
 from ..oracle.state_builder import StateBuilder
 from .persistence import Stores
 
@@ -123,6 +128,9 @@ class TPUReplayEngine:
         for i, key in enumerate(keys):
             live_ms = self.stores.execution.get_workflow(*key)
             expected = payload_row(live_ms, self.layout)
+            # sticky state is active-side only; replay clears it
+            # (STICKY_ROW_INDEX note in core/checksum.py)
+            expected[STICKY_ROW_INDEX] = 0
             if errors[i] != 0:
                 # device flagged this workflow: oracle fallback
                 result.device_errors.append((key, int(errors[i])))

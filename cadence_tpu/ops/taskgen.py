@@ -265,7 +265,12 @@ def step_tasks(s_new: ReplayState, ev: jnp.ndarray,
     # (state_builder.go:204-208,:250-259,:272-281; task_generator.go:315-350;
     # no schedule-to-start timer on the replay path)
     m_dsched = m(EventType.DecisionTaskScheduled)
-    m_dfail = m(EventType.DecisionTaskFailed) | m(EventType.DecisionTaskTimedOut)
+    # a schedule-to-start timeout creates no transient (attempt stays 0,
+    # state_builder.go ReplicateTransientDecisionTaskScheduled), so no
+    # dispatch task either — the explicit follow-up scheduled event emits it
+    m_dtimeout = m(EventType.DecisionTaskTimedOut)
+    m_dfail = (m(EventType.DecisionTaskFailed)
+               | (m_dtimeout & (a[0] != int(TimeoutType.ScheduleToStart))))
     log = emit_transfer(log, m_dsched | m_dfail,
                         jnp.int64(TransferTaskType.DecisionTask),
                         s_new.decision_version, s_new.decision_schedule_id)

@@ -27,6 +27,7 @@ from ..core.enums import (
     NANOS_PER_SECOND,
     CloseStatus,
     EventType,
+    TimeoutType,
     WorkflowState,
 )
 from .encode import (
@@ -390,21 +391,30 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     # original scheduled timestamp deliberately kept (:690-691)
     last_processed = _sel(m_dcomp, a[1], last_processed)
 
-    # DecisionTaskFailed / TimedOut: FailDecision(increment=True) then
-    # transient decision (:643-676, :168-197; state_builder.go:237-281).
-    # Stickiness is cleared on this path, so attempt always increments and,
-    # attempt being >0 with no pending decision, the transient is always
-    # created: schedule ID = stale next_event_id (see :173-182).
-    m_dfail = m(EventType.DecisionTaskFailed) | m(EventType.DecisionTaskTimedOut)
+    # DecisionTaskFailed / TimedOut: FailDecision then transient decision
+    # (:643-676, :168-197; state_builder.go:237-281). A SCHEDULE-TO-START
+    # timeout (the sticky dispatch deadline, :256-271) does NOT increment
+    # the attempt — decision state clears fully and no transient is
+    # created (attempt 0); every other fail/timeout increments, and with
+    # attempt >0 and no pending decision the transient is always created:
+    # schedule ID = stale next_event_id (see :173-182).
+    m_dtimeout = m(EventType.DecisionTaskTimedOut)
+    m_noinc = m_dtimeout & (a[0] == int(TimeoutType.ScheduleToStart))
+    m_dfail = (m(EventType.DecisionTaskFailed) | m_dtimeout) & ~m_noinc
     attempt_after_fail = d_attempt + 1
     d_version = _sel(m_dfail, current_version, d_version)
+    d_version = _sel(m_noinc, jnp.int64(EMPTY_VERSION), d_version)
     d_sched = _sel(m_dfail, s.next_event_id, d_sched)
-    d_started = _sel(m_dfail, jnp.int64(EMPTY_EVENT_ID), d_started)
+    d_sched = _sel(m_noinc, jnp.int64(EMPTY_EVENT_ID), d_sched)
+    d_started = _sel(m_dfail | m_noinc, jnp.int64(EMPTY_EVENT_ID), d_started)
     d_attempt = _sel(m_dfail, attempt_after_fail, d_attempt)
+    d_attempt = _sel(m_noinc, jnp.int64(0), d_attempt)
     d_timeout = _sel(m_dfail, decision_sts_timeout, d_timeout)
+    d_timeout = _sel(m_noinc, jnp.int64(0), d_timeout)
     d_sched_ts = _sel(m_dfail, ts, d_sched_ts)
-    d_started_ts = _sel(m_dfail, jnp.int64(0), d_started_ts)
-    d_orig_ts = _sel(m_dfail, jnp.int64(0), d_orig_ts)
+    d_sched_ts = _sel(m_noinc, jnp.int64(0), d_sched_ts)
+    d_started_ts = _sel(m_dfail | m_noinc, jnp.int64(0), d_started_ts)
+    d_orig_ts = _sel(m_dfail | m_noinc, jnp.int64(0), d_orig_ts)
 
     # ------------------------------------------------------------------
     # Activities

@@ -434,6 +434,7 @@ class MutableState:
         "cross_cluster_tasks",
         "domain_entry",
         "history_size",
+        "buffered_events",
     )
 
     def __init__(self, domain_entry: Optional["DomainEntry"] = None) -> None:
@@ -452,6 +453,11 @@ class MutableState:
         self.cross_cluster_tasks: List[GeneratedTask] = []
         self.domain_entry = domain_entry if domain_entry is not None else DomainEntry()
         self.history_size: int = 0
+        #: events received while a decision is in flight, awaiting ID
+        #: assignment at decision close (mutable_state_builder.go:112-114
+        #: bufferedEvents / updateBufferedEvents); entries carry
+        #: BUFFERED_EVENT_ID until FlushBufferedEvents reassigns them
+        self.buffered_events: List["HistoryEvent"] = []
 
     # -- version bookkeeping ------------------------------------------------
 

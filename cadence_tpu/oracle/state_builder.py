@@ -45,10 +45,17 @@ class StateBuilder:
     """Replays event batches into a MutableState (passive/rebuild path)."""
 
     def __init__(self, mutable_state: Optional[MutableState] = None,
-                 domain_entry: Optional[DomainEntry] = None) -> None:
+                 domain_entry: Optional[DomainEntry] = None,
+                 clear_sticky: bool = True) -> None:
         self.ms = mutable_state if mutable_state is not None else MutableState(domain_entry)
         #: mutable state of the continued-as-new run, when one was applied
         self.new_run_state: Optional[MutableState] = None
+        #: the REPLAY path clears stickyness — the workflow turned passive
+        #: (state_builder.go:108); the ACTIVE engine routes its own
+        #: transactions through this same builder (active ≡ replayed by
+        #: construction) and passes False so sticky execution survives
+        #: between decisions
+        self.clear_sticky = clear_sticky
 
     # ------------------------------------------------------------------
     # Entry points
@@ -69,7 +76,8 @@ class StateBuilder:
         last_event = batch.events[-1]
 
         # need to clear the stickiness since workflow turned to passive (:108)
-        ms.clear_stickyness()
+        if self.clear_sticky:
+            ms.clear_stickyness()
 
         for event in batch.events:
             ms.update_current_version(event.version, force_update=True)  # :112
@@ -451,15 +459,11 @@ class StateBuilder:
         self._update_decision(fail_info)
 
     def _replicate_decision_task_timed_out(self, timeout_type: TimeoutType) -> None:
-        """Reference: mutable_state_decision_task_manager.go:256-271."""
-        increment = True
-        if (
-            timeout_type == TimeoutType.ScheduleToStart
-            and self.ms.execution_info.sticky_task_list != ""
-        ):
-            increment = False
-        # `now` is irrelevant when increment resolves the same way as reference:
-        # stickiness is cleared on the replay path, so increment stays True.
+        """Reference: mutable_state_decision_task_manager.go:256-271 — a
+        schedule-to-start timeout (the sticky-decision dispatch deadline)
+        does NOT increment the attempt, so the follow-up decision is a real
+        scheduled event on the normal task list, never a transient."""
+        increment = timeout_type != TimeoutType.ScheduleToStart
         self._fail_decision(increment, now=0)
 
     # -- activities ---------------------------------------------------------

@@ -1,0 +1,370 @@
+"""Buffered events + sticky execution.
+
+Round-3 VERDICT ask #3:
+- events arriving while a decision is IN FLIGHT buffer in mutable state
+  and flush at decision close with reference event ordering
+  (mutable_state_builder.go:415 FlushBufferedEvents, completion events
+  reordered to the back);
+- close decisions racing a non-empty buffer fail with UNHANDLED_DECISION;
+- sticky task lists pin decision dispatch to the last worker; the sticky
+  schedule-to-start timeout falls back to the normal task list WITHOUT
+  incrementing the attempt (mutable_state_decision_task_manager.go:256-271).
+"""
+import pytest
+
+from cadence_tpu.core.enums import (
+    EMPTY_EVENT_ID,
+    CloseStatus,
+    DecisionType,
+    EventType,
+    TimeoutType,
+    WorkflowState,
+)
+from cadence_tpu.engine.history_engine import Decision, InvalidRequestError
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import EchoDecider, SignalDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "buf-domain"
+TL = "buf-tl"
+STICKY = "buf-tl-sticky"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _poll_decision(box, wf):
+    box.pump_once()
+    resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+    assert resp is not None and resp.token.workflow_id == wf
+    return resp
+
+
+class TestBufferedEvents:
+    def test_signal_during_decision_buffers_and_flushes(self, box):
+        """A signal landing mid-decision appears AFTER DecisionTaskCompleted
+        in history — the reference's persisted ordering — and triggers a
+        fresh decision."""
+        box.frontend.start_workflow_execution(DOMAIN, "buf-1", "signal", TL)
+        resp = _poll_decision(box, "buf-1")  # decision 1 now in flight
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-1")
+
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-1", "mid-flight")
+        ms = box.stores.execution.get_workflow(domain_id, "buf-1", run_id)
+        assert len(ms.buffered_events) == 1
+        # the signal is NOT in history yet
+        kinds = [e.event_type for e in
+                 box.stores.history.read_events(domain_id, "buf-1", run_id)]
+        assert EventType.WorkflowExecutionSignaled not in kinds
+
+        box.frontend.respond_decision_task_completed(resp.token, [])
+        events = box.stores.history.read_events(domain_id, "buf-1", run_id)
+        kinds = [e.event_type for e in events]
+        i_completed = kinds.index(EventType.DecisionTaskCompleted)
+        i_signal = kinds.index(EventType.WorkflowExecutionSignaled)
+        assert i_signal == i_completed + 1
+        # flushed buffer scheduled a follow-up decision
+        assert kinds[i_signal + 1] == EventType.DecisionTaskScheduled
+        ms = box.stores.execution.get_workflow(domain_id, "buf-1", run_id)
+        assert not ms.buffered_events
+        assert ms.execution_info.signal_count == 1
+        assert box.tpu.verify_all().ok
+
+    def test_close_decision_with_buffer_fails_unhandled(self, box):
+        """CompleteWorkflow racing a buffered signal → UNHANDLED_DECISION:
+        the decision fails, the buffer flushes, and the workflow completes
+        only after re-deciding with the signal visible."""
+        box.frontend.start_workflow_execution(DOMAIN, "buf-2", "signal", TL)
+        resp = _poll_decision(box, "buf-2")
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-2")
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-2", "racer")
+
+        box.frontend.respond_decision_task_completed(
+            resp.token, [Decision(DecisionType.CompleteWorkflowExecution, {})])
+        ms = box.stores.execution.get_workflow(domain_id, "buf-2", run_id)
+        # still running: the close was rejected
+        assert ms.execution_info.state == WorkflowState.Running
+        kinds = [e.event_type for e in
+                 box.stores.history.read_events(domain_id, "buf-2", run_id)]
+        i_failed = kinds.index(EventType.DecisionTaskFailed)
+        assert kinds[i_failed + 1] == EventType.WorkflowExecutionSignaled
+        assert kinds[i_failed + 2] == EventType.DecisionTaskScheduled
+
+        # the re-decision sees the signal and completes
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"buf-2": SignalDecider(expected_signals=1)})
+        poller.drain()
+        ms = box.stores.execution.get_workflow(domain_id, "buf-2", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_activity_completion_reorders_behind_started(self, box):
+        """An activity started AND completed while a decision is in flight:
+        both buffer; the flush emits started before completed (reorderBuffer
+        moves completion events to the back) with patched started IDs."""
+        box.frontend.start_workflow_execution(DOMAIN, "buf-3", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"buf-3": EchoDecider(TL)})
+        # decision 1 schedules the activity
+        box.pump_once()
+        assert poller.poll_and_decide_once()
+        box.pump_once()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-3")
+
+        # force decision 2 in flight via a signal
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-3", "hold")
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        assert resp2 is not None
+
+        # activity starts AND completes while decision 2 runs
+        act = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        assert act is not None
+        box.frontend.respond_activity_task_completed(act.token)
+        ms = box.stores.execution.get_workflow(domain_id, "buf-3", run_id)
+        types_buf = [e.event_type for e in ms.buffered_events]
+        assert types_buf == [EventType.ActivityTaskStarted,
+                             EventType.ActivityTaskCompleted]
+
+        box.frontend.respond_decision_task_completed(resp2.token, [])
+        events = box.stores.history.read_events(domain_id, "buf-3", run_id)
+        kinds = [e.event_type for e in events]
+        i_started = kinds.index(EventType.ActivityTaskStarted)
+        i_closed = kinds.index(EventType.ActivityTaskCompleted)
+        assert i_started < i_closed
+        started_ev = events[i_started]
+        closed_ev = events[i_closed]
+        # the buffered completion's started reference was patched to the
+        # flushed started event's real ID
+        assert closed_ev.get("started_event_id") == started_ev.id
+        # drain to completion: decider sees the completion and closes
+        poller.drain()
+        ms = box.stores.execution.get_workflow(domain_id, "buf-3", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_double_respond_buffered_close_rejected(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "buf-4", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"buf-4": EchoDecider(TL)})
+        box.pump_once()
+        assert poller.poll_and_decide_once()
+        box.pump_once()
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-4", "hold")
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        act = box.frontend.poll_for_activity_task(DOMAIN, TL)
+        box.frontend.respond_activity_task_completed(act.token)
+        with pytest.raises(InvalidRequestError):
+            box.frontend.respond_activity_task_completed(act.token)
+
+    def test_buffered_start_token_survives_flush(self, box):
+        """An activity token minted while its start was buffered must stay
+        valid after the flush assigns the real started event ID."""
+        box.frontend.start_workflow_execution(DOMAIN, "buf-6", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"buf-6": EchoDecider(TL)})
+        box.pump_once()
+        assert poller.poll_and_decide_once()  # schedules the activity
+        box.pump_once()
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-6", "hold")
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        act = box.frontend.poll_for_activity_task(DOMAIN, TL)  # start buffers
+        box.frontend.respond_decision_task_completed(resp2.token, [])  # flush
+        # respond with the pre-flush token: must be accepted
+        box.frontend.respond_activity_task_completed(act.token)
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-6")
+        ms = box.stores.execution.get_workflow(domain_id, "buf-6", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_cancel_timer_scrubs_buffered_fire(self, box):
+        """CancelTimer racing a buffered TimerFired: the buffered fire is
+        scrubbed (checkAndClearTimerFiredEvent) and the cancel wins."""
+        from cadence_tpu.models.deciders import TimerDecider
+
+        box.frontend.start_workflow_execution(DOMAIN, "buf-7", "timer", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"buf-7": TimerDecider(fire_seconds=30)})
+        box.pump_once()
+        assert poller.poll_and_decide_once()  # starts timer t-0
+        box.pump_once()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-7")
+        ms = box.stores.execution.get_workflow(domain_id, "buf-7", run_id)
+        started_id = next(iter(ms.pending_timer_info_ids.values())).started_id
+
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-7", "hold")
+        box.pump_once()
+        resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        # fire lands while the decision is in flight → buffered
+        box.route("buf-7").fire_user_timer(domain_id, "buf-7", run_id,
+                                           started_id)
+        ms = box.stores.execution.get_workflow(domain_id, "buf-7", run_id)
+        assert any(e.event_type == EventType.TimerFired
+                   for e in ms.buffered_events)
+        # worker decides to cancel that very timer
+        box.frontend.respond_decision_task_completed(
+            resp.token, [Decision(DecisionType.CancelTimer,
+                                  dict(timer_id="t-0"))])
+        kinds = [e.event_type for e in
+                 box.stores.history.read_events(domain_id, "buf-7", run_id)]
+        assert EventType.TimerCanceled in kinds
+        assert EventType.TimerFired not in kinds
+        ms = box.stores.execution.get_workflow(domain_id, "buf-7", run_id)
+        assert not ms.pending_timer_info_ids
+        assert box.tpu.verify_all().ok
+
+    def test_child_started_and_closed_both_buffered(self, box):
+        """Child start + close both landing behind one in-flight decision:
+        the flushed close links to the flushed started event's real ID."""
+        box.frontend.start_workflow_execution(DOMAIN, "buf-8", "parent", TL)
+        box.pump_once()
+        resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        box.frontend.respond_decision_task_completed(
+            resp.token, [Decision(DecisionType.StartChildWorkflowExecution,
+                                  dict(workflow_id="buf-8-child",
+                                       workflow_type="child-type"))])
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-8")
+        events = box.stores.history.read_events(domain_id, "buf-8", run_id)
+        initiated = next(
+            e.id for e in events
+            if e.event_type == EventType.StartChildWorkflowExecutionInitiated)
+
+        # the signal schedules a decision; inject its matching task WITHOUT
+        # pumping the queues, so the child-start transfer task stays parked
+        # until the decision is in flight
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-8", "hold")
+        ms = box.stores.execution.get_workflow(domain_id, "buf-8", run_id)
+        box.matching.add_decision_task(
+            domain_id, TL, "buf-8", run_id,
+            ms.execution_info.decision_schedule_id)
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        assert resp2 is not None
+        engine = box.route("buf-8")
+        engine.on_child_started(domain_id, "buf-8", run_id, initiated, "c-run")
+        # redelivery while buffered is a no-op (already-started guard)
+        engine.on_child_started(domain_id, "buf-8", run_id, initiated, "c-run")
+        engine.on_child_closed(domain_id, "buf-8", run_id, initiated,
+                               EventType.ChildWorkflowExecutionCompleted)
+        ms = box.stores.execution.get_workflow(domain_id, "buf-8", run_id)
+        assert len(ms.buffered_events) == 2
+
+        box.frontend.respond_decision_task_completed(resp2.token, [])
+        events = box.stores.history.read_events(domain_id, "buf-8", run_id)
+        started_ev = next(e for e in events if e.event_type
+                          == EventType.ChildWorkflowExecutionStarted)
+        closed_ev = next(e for e in events if e.event_type
+                         == EventType.ChildWorkflowExecutionCompleted)
+        assert started_ev.id < closed_ev.id
+        assert closed_ev.get("started_event_id") == started_ev.id
+        kinds = [e.event_type for e in events]
+        assert kinds.count(EventType.ChildWorkflowExecutionStarted) == 1
+        assert box.tpu.verify_all().ok
+
+    def test_terminate_discards_buffer(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "buf-5", "signal", TL)
+        resp = _poll_decision(box, "buf-5")
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "buf-5")
+        box.frontend.signal_workflow_execution(DOMAIN, "buf-5", "dropped")
+        box.frontend.terminate_workflow_execution(DOMAIN, "buf-5")
+        ms = box.stores.execution.get_workflow(domain_id, "buf-5", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Terminated
+        assert not ms.buffered_events
+        kinds = [e.event_type for e in
+                 box.stores.history.read_events(domain_id, "buf-5", run_id)]
+        assert EventType.WorkflowExecutionSignaled not in kinds
+        assert box.tpu.verify_all().ok
+
+
+class TestSticky:
+    def test_sticky_pins_next_decision(self, box):
+        """After a completion with sticky attributes, the next decision
+        dispatches on the STICKY task list."""
+        box.frontend.start_workflow_execution(DOMAIN, "st-1", "signal", TL)
+        resp = _poll_decision(box, "st-1")
+        box.frontend.respond_decision_task_completed(
+            resp.token, [], sticky_task_list=STICKY,
+            sticky_schedule_to_start_timeout=5)
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "st-1")
+        ms = box.stores.execution.get_workflow(domain_id, "st-1", run_id)
+        assert ms.execution_info.sticky_task_list == STICKY
+
+        box.frontend.signal_workflow_execution(DOMAIN, "st-1", "go")
+        box.pump_once()
+        # nothing on the normal list; the decision is on the sticky list
+        assert box.frontend.poll_for_decision_task(DOMAIN, TL) is None
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, STICKY)
+        assert resp2 is not None
+        box.frontend.respond_decision_task_completed(
+            resp2.token, [Decision(DecisionType.CompleteWorkflowExecution, {})])
+        ms = box.stores.execution.get_workflow(domain_id, "st-1", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        # verify_all masks the sticky hash (replay clears stickyness)
+        assert box.tpu.verify_all().ok
+
+    def test_sticky_schedule_to_start_timeout_falls_back(self, box):
+        """Sticky worker dies: the schedule-to-start timer fires, the
+        decision re-dispatches on the NORMAL list with attempt NOT
+        incremented (the non-increment FailDecision path) and stickyness
+        cleared."""
+        box.frontend.start_workflow_execution(DOMAIN, "st-2", "signal", TL)
+        resp = _poll_decision(box, "st-2")
+        box.frontend.respond_decision_task_completed(
+            resp.token, [], sticky_task_list=STICKY,
+            sticky_schedule_to_start_timeout=5)
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "st-2")
+
+        box.frontend.signal_workflow_execution(DOMAIN, "st-2", "go")
+        box.pump_once()  # decision scheduled on sticky list; nobody polls it
+        box.advance_time(6)
+        box.pump_once()  # schedule-to-start timer fires
+
+        events = box.stores.history.read_events(domain_id, "st-2", run_id)
+        kinds = [e.event_type for e in events]
+        i_timeout = kinds.index(EventType.DecisionTaskTimedOut)
+        timed_out = events[i_timeout]
+        assert timed_out.get("timeout_type") == int(TimeoutType.ScheduleToStart)
+        # explicit re-schedule follows, attempt stays 0, sticky cleared
+        assert kinds[i_timeout + 1] == EventType.DecisionTaskScheduled
+        assert events[i_timeout + 1].get("attempt") == 0
+        assert events[i_timeout + 1].get("task_list") == TL
+        ms = box.stores.execution.get_workflow(domain_id, "st-2", run_id)
+        assert ms.execution_info.sticky_task_list == ""
+        assert ms.execution_info.decision_attempt == 0
+
+        # the normal list serves it now
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        assert resp2 is not None
+        box.frontend.respond_decision_task_completed(
+            resp2.token, [Decision(DecisionType.CompleteWorkflowExecution, {})])
+        ms = box.stores.execution.get_workflow(domain_id, "st-2", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_completion_without_sticky_clears(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "st-3", "signal", TL)
+        resp = _poll_decision(box, "st-3")
+        box.frontend.respond_decision_task_completed(
+            resp.token, [], sticky_task_list=STICKY,
+            sticky_schedule_to_start_timeout=5)
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "st-3")
+        box.frontend.signal_workflow_execution(DOMAIN, "st-3", "a")
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, STICKY)
+        box.frontend.respond_decision_task_completed(resp2.token, [])
+        ms = box.stores.execution.get_workflow(domain_id, "st-3", run_id)
+        assert ms.execution_info.sticky_task_list == ""

@@ -132,10 +132,14 @@ class TestStartAndDecision:
             ev(3, EventType.DecisionTaskTimedOut, scheduled_event_id=2,
                timeout_type=int(TimeoutType.ScheduleToStart)),
         ]))
-        # non-sticky => attempt increments; transient decision at next id (3)
+        # a schedule-to-start timeout NEVER increments the attempt
+        # (mutable_state_decision_task_manager.go:256-271: the sticky
+        # dispatch deadline re-dispatches on the normal task list via an
+        # explicit scheduled event, not a transient) — decision state
+        # clears fully, attempt stays 0, no transient is created
         info = sb.ms.execution_info
-        assert info.decision_attempt == 1
-        assert info.decision_schedule_id == 3
+        assert info.decision_attempt == 0
+        assert info.decision_schedule_id == EMPTY_EVENT_ID
 
 
 class TestActivitiesTimers:
