@@ -25,11 +25,21 @@ from .persistence import DomainInfo, Stores, VisibilityRecord
 
 
 class PollDecisionResponse:
-    def __init__(self, token: TaskToken, history: List[HistoryEvent],
-                 previous_started_event_id: int) -> None:
+    def __init__(self, token: Optional[TaskToken], history: List[HistoryEvent],
+                 previous_started_event_id: int,
+                 queries: Optional[List[tuple]] = None,
+                 query_only: bool = False,
+                 execution: Optional[tuple] = None) -> None:
         self.token = token
         self.history = history
         self.previous_started_event_id = previous_started_event_id
+        #: (query_id, query_type, args) triples attached to this task
+        self.queries = queries or []
+        #: True for a query-only task (no decision token; answer via
+        #: respond_query_task_completed)
+        self.query_only = query_only
+        #: (domain_id, workflow_id, run_id) for query-only responses
+        self.execution = execution
 
 
 class PollActivityResponse:
@@ -132,6 +142,17 @@ class Frontend:
         if task is None:
             return None
         engine = self.router(task.workflow_id)
+        key = (task.domain_id, task.workflow_id, task.run_id)
+        if task.query_id:
+            # query-only task: no history mutation, no decision token;
+            # ship the buffered queries with current history so the worker
+            # can answer (matchingEngine QueryWorkflow → worker)
+            history = engine.get_history(task.domain_id, task.workflow_id,
+                                         task.run_id)
+            return PollDecisionResponse(
+                token=None, history=history, previous_started_event_id=0,
+                queries=engine.queries.attach(key), query_only=True,
+                execution=key)
         from .history_engine import InvalidRequestError
         try:
             token = engine.record_decision_task_started(
@@ -145,16 +166,95 @@ class Frontend:
                                      task.run_id)
         return PollDecisionResponse(
             token=token, history=history,
-            previous_started_event_id=ms.execution_info.last_processed_event)
+            previous_started_event_id=ms.execution_info.last_processed_event,
+            queries=engine.queries.attach(key), execution=key)
 
     def respond_decision_task_completed(self, token: TaskToken,
                                         decisions: List[Decision],
                                         sticky_task_list: str = "",
-                                        sticky_schedule_to_start_timeout: int = 0
+                                        sticky_schedule_to_start_timeout: int = 0,
+                                        query_results: Optional[Dict[str, bytes]] = None
                                         ) -> None:
         self.router(token.workflow_id).respond_decision_task_completed(
             token, decisions, sticky_task_list=sticky_task_list,
-            sticky_schedule_to_start_timeout=sticky_schedule_to_start_timeout)
+            sticky_schedule_to_start_timeout=sticky_schedule_to_start_timeout,
+            query_results=query_results)
+        # queries still buffered after the completion (arrived mid-decision,
+        # unanswered by this worker) must not wait for a decision that may
+        # never come: dispatch them directly (the reference forwards leftover
+        # buffered queries through matching after decision completion)
+        self._dispatch_buffered_queries(token.domain_id, token.workflow_id,
+                                        token.run_id)
+
+    def _dispatch_buffered_queries(self, domain_id: str, workflow_id: str,
+                                   run_id: str) -> None:
+        from ..core.enums import EMPTY_EVENT_ID, WorkflowState
+        engine = self.router(workflow_id)
+        key = (domain_id, workflow_id, run_id)
+        buffered = engine.queries.buffered_ids(key)
+        if not buffered:
+            return
+        try:
+            ms = engine.get_mutable_state(domain_id, workflow_id, run_id)
+        except Exception:
+            return
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            engine.queries.fail_all(key, "workflow execution closed")
+            return
+        if info.decision_schedule_id != EMPTY_EVENT_ID:
+            return  # a decision is coming; queries attach to its poll
+        # one trigger task suffices: the poll's attach() ships every
+        # buffered query. Always the NORMAL task list — a stale sticky
+        # list would park the query behind a dead worker with no
+        # schedule-to-start fallback (query tasks have no timer)
+        self.matching.add_query_task(domain_id, info.task_list,
+                                     workflow_id, run_id, buffered[0])
+
+    # -- consistent query (workflowHandler.go:3454 QueryWorkflow →
+    # query/registry.go buffered queries) ----------------------------------
+
+    def query_workflow(self, domain: str, workflow_id: str, query_type: str,
+                       args: bytes = b"", run_id: Optional[str] = None) -> str:
+        """Register a query; returns its ID. A workflow with a decision
+        pending or in flight answers with that decision's completion
+        (consistent query); an idle workflow gets a query-only task
+        dispatched directly through matching."""
+        from ..core.enums import EMPTY_EVENT_ID, WorkflowState
+        from .history_engine import InvalidRequestError
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        engine = self.router(workflow_id)
+        ms = engine.get_mutable_state(domain_id, workflow_id, run_id)
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            raise InvalidRequestError("workflow execution already completed")
+        key = (domain_id, workflow_id, info.run_id)
+        query_id = engine.queries.buffer(key, query_type, args)
+        if info.decision_schedule_id == EMPTY_EVENT_ID:
+            # always the NORMAL task list: a stale sticky list would park
+            # the query behind a dead worker (query tasks carry no
+            # schedule-to-start fallback timer)
+            self.matching.add_query_task(domain_id, info.task_list,
+                                         workflow_id, info.run_id, query_id)
+        return query_id
+
+    def get_query_result(self, domain: str, workflow_id: str, query_id: str,
+                         run_id: Optional[str] = None):
+        """(state, result, failure) of a registered query."""
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        engine = self.router(workflow_id)
+        if run_id is None:
+            run_id = self.stores.execution.get_current_run_id(
+                domain_id, workflow_id)
+        q = engine.queries.get((domain_id, workflow_id, run_id), query_id)
+        if q is None:
+            raise KeyError(f"unknown query {query_id}")
+        return q.state, q.result, q.failure
+
+    def respond_query_task_completed(self, execution: tuple, query_id: str,
+                                     result: bytes) -> None:
+        """Answer a query-only task (RespondQueryTaskCompleted analog)."""
+        self.router(execution[1]).queries.complete(execution, query_id, result)
 
     def poll_for_activity_task(self, domain: str, task_list: str
                                ) -> Optional[PollActivityResponse]:

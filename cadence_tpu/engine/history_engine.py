@@ -87,6 +87,10 @@ class HistoryEngine:
         #: shared holder so a cluster can attach its replication publisher to
         #: engines created before/after wiring ({"pub": ReplicationPublisher})
         self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
+        #: consistent-query registry (query/registry.go); the owning
+        #: cluster replaces this with its shared instance
+        from .query import QueryRegistry
+        self.queries = QueryRegistry()
 
     def _replication_target(self, domain_id: str, ms: MutableState):
         """Shared gate for both replication publish paths: (publisher,
@@ -206,6 +210,26 @@ class HistoryEngine:
                         EventType.TimerFired, EventType.TimerCanceled):
                     return True
         return False
+
+    def _flush_and_reschedule(self, txn: "_Txn", ms: MutableState,
+                              sticky: bool = False) -> int:
+        """Flush the buffer after a decision fail/timeout close event and,
+        when anything flushed, append a REAL scheduled event (attempt 0) —
+        a transient's provisional schedule ID would collide with the
+        flushed events' IDs (mutable_state_decision_task_manager.go:373-382).
+        The replay of the close event still momentarily creates a transient
+        whose dispatch task would be stale; txn.commit drops it (the
+        reference's active side never creates it at all)."""
+        info = ms.execution_info
+        flushed = self._flush_buffered(txn, ms)
+        if flushed:
+            txn.add(EventType.DecisionTaskScheduled,
+                    task_list=(info.sticky_task_list or info.task_list)
+                    if sticky else info.task_list,
+                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
+                    attempt=0)
+            txn.drop_stale_decision_tasks = True
+        return flushed
 
     def _flush_buffered(self, txn: "_Txn", ms: MutableState) -> int:
         """Assign real event IDs to the buffer, completion events last;
@@ -364,7 +388,8 @@ class HistoryEngine:
     def respond_decision_task_completed(self, token: TaskToken,
                                         decisions: List[Decision],
                                         sticky_task_list: str = "",
-                                        sticky_schedule_to_start_timeout: int = 0
+                                        sticky_schedule_to_start_timeout: int = 0,
+                                        query_results: Optional[Dict[str, bytes]] = None
                                         ) -> None:
         """RespondDecisionTaskCompleted (historyEngine.go:1787 →
         decision/handler.go:285, per-decision translation per
@@ -388,6 +413,14 @@ class HistoryEngine:
                 or info.decision_started_id != token.started_id):
             raise InvalidRequestError("decision task no longer current")
 
+        # queries attached to this decision complete regardless of the
+        # decision outcome; unanswered started queries re-buffer for the
+        # next decision (historyEngine query-result reconciliation)
+        qkey = (token.domain_id, token.workflow_id, token.run_id)
+        for qid, qres in (query_results or {}).items():
+            self.queries.complete(qkey, qid, qres)
+        self.queries.requeue_started(qkey)
+
         if ms.buffered_events and any(d.decision_type in self._CLOSE_DECISIONS
                                       for d in decisions):
             # UnhandledDecision: the close must not race the buffer; the
@@ -398,10 +431,7 @@ class HistoryEngine:
                     scheduled_event_id=token.schedule_id,
                     started_event_id=token.started_id,
                     cause="UNHANDLED_DECISION")
-            self._flush_buffered(txn, ms)
-            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
-                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
-                    attempt=0)
+            self._flush_and_reschedule(txn, ms)
             txn.commit(expected)
             return
 
@@ -436,6 +466,8 @@ class HistoryEngine:
                     start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
                     attempt=0)
         txn.commit(expected)
+        if closed:
+            self.queries.fail_all(qkey, "workflow execution closed")
         # continue-as-new chaining is handled inside _apply_decision
 
     def _apply_decision(self, txn: "_Txn", ms: MutableState,
@@ -655,16 +687,15 @@ class HistoryEngine:
         IDs — mutable_state_decision_task_manager.go:373-382), so the
         buffer flushes and a REAL scheduled event follows with attempt 0."""
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
-        info = ms.execution_info
         txn = self._new_transaction(ms)
         txn.add(EventType.DecisionTaskFailed,
                 scheduled_event_id=token.schedule_id,
                 started_event_id=token.started_id, cause=cause)
-        if self._flush_buffered(txn, ms):
-            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
-                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
-                    attempt=0)
+        self._flush_and_reschedule(txn, ms)
         txn.commit(expected)
+        # queries attached to the failed decision ride the next one
+        self.queries.requeue_started(
+            (token.domain_id, token.workflow_id, token.run_id))
 
     # ------------------------------------------------------------------
     # Activity task lifecycle
@@ -882,6 +913,9 @@ class HistoryEngine:
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionTerminated, reason=reason)
         txn.commit(expected)
+        self.queries.fail_all(
+            (domain_id, workflow_id, ms.execution_info.run_id),
+            "workflow execution terminated")
 
     def reset_workflow(self, domain_id: str, workflow_id: str,
                        run_id: Optional[str] = None, *,
@@ -1083,11 +1117,9 @@ class HistoryEngine:
         # the timed-out decision's buffer flushes behind the close event;
         # like the failed path, flushed events force a REAL follow-up
         # decision instead of a transient (:373-382)
-        if self._flush_buffered(txn, ms):
-            txn.add(EventType.DecisionTaskScheduled, task_list=info.task_list,
-                    start_to_close_timeout_seconds=info.decision_start_to_close_timeout,
-                    attempt=0)
+        self._flush_and_reschedule(txn, ms)
         txn.commit(expected)
+        self.queries.requeue_started((domain_id, workflow_id, run_id))
 
     def timeout_workflow(self, domain_id: str, workflow_id: str, run_id: str) -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)
@@ -1097,6 +1129,8 @@ class HistoryEngine:
         txn = self._new_transaction(ms)
         txn.add(EventType.WorkflowExecutionTimedOut)
         txn.commit(expected)
+        self.queries.fail_all((domain_id, workflow_id, run_id),
+                              "workflow execution timed out")
 
     def schedule_first_decision(self, domain_id: str, workflow_id: str,
                                 run_id: str) -> None:
@@ -1274,6 +1308,11 @@ class _Txn:
         #: IDs introduced earlier in this batch (pre-commit dedup)
         self.added_activity_ids: set = set()
         self.added_timer_ids: set = set()
+        #: set by _flush_and_reschedule: drop decision dispatch tasks for
+        #: any schedule ID other than the final one (the replay of the
+        #: fail/timeout close event momentarily creates a transient whose
+        #: provisional ID a flushed event then takes)
+        self.drop_stale_decision_tasks = False
 
     def add(self, event_type: EventType, **attrs: Any) -> HistoryEvent:
         ev = HistoryEvent(
@@ -1314,6 +1353,13 @@ class _Txn:
         StateBuilder(self.ms, clear_sticky=False).apply_batch(batch)
         new_transfer = list(self.ms.transfer_tasks)
         new_timer = list(self.ms.timer_tasks)
+        if self.drop_stale_decision_tasks:
+            from ..core.enums import TransferTaskType
+            final_sched = self.ms.execution_info.decision_schedule_id
+            new_transfer = [
+                t for t in new_transfer
+                if not (t.task_type == TransferTaskType.DecisionTask
+                        and t.event_id != final_sched)]
         # tasks are drained into the shard queues at commit; the persisted
         # snapshot must not accumulate them across transactions
         self.ms.transfer_tasks, self.ms.timer_tasks = [], []

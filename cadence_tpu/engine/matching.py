@@ -28,6 +28,10 @@ class MatchedTask:
     run_id: str
     schedule_id: int
     task_list: str
+    #: set on query-only tasks (the consistent-query direct path: a query
+    #: task rides the decision task list without any history mutation,
+    #: matchingEngine QueryWorkflow passthrough)
+    query_id: str = ""
 
 
 class _TaskListManager:
@@ -40,6 +44,9 @@ class _TaskListManager:
             domain_id, name, task_type)
         self._lock = threading.Lock()
         self._buffer: Deque[PersistedTask] = deque()
+        #: query-only tasks: transient, never persisted (a lost query is
+        #: retried by the caller; the reference's query tasks are sync-only)
+        self._query_buffer: Deque[tuple] = deque()
         self._next_task_id = self._info.range_id * 100000
         self._ack = 0
 
@@ -66,9 +73,19 @@ class _TaskListManager:
                 self._ack)
             return task
 
+    def add_query(self, domain_id: str, workflow_id: str, run_id: str,
+                  query_id: str) -> None:
+        with self._lock:
+            self._query_buffer.append((domain_id, workflow_id, run_id,
+                                       query_id))
+
+    def poll_query(self) -> Optional[tuple]:
+        with self._lock:
+            return self._query_buffer.popleft() if self._query_buffer else None
+
     def backlog(self) -> int:
         with self._lock:
-            return len(self._buffer)
+            return len(self._buffer) + len(self._query_buffer)
 
 
 class MatchingEngine:
@@ -101,9 +118,21 @@ class MatchingEngine:
 
     # -- polls (called by workers via frontend) ----------------------------
 
+    def add_query_task(self, domain_id: str, task_list: str,
+                       workflow_id: str, run_id: str, query_id: str) -> None:
+        """Dispatch a query-only task (matchingEngine QueryWorkflow)."""
+        self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).add_query(
+            domain_id, workflow_id, run_id, query_id)
+
     def poll_for_decision_task(self, domain_id: str, task_list: str
                                ) -> Optional[MatchedTask]:
-        task = self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).poll()
+        mgr = self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION)
+        q = mgr.poll_query()
+        if q is not None:
+            return MatchedTask(domain_id=q[0], workflow_id=q[1], run_id=q[2],
+                               schedule_id=-1, task_list=task_list,
+                               query_id=q[3])
+        task = mgr.poll()
         if task is None:
             return None
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,

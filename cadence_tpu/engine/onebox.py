@@ -58,11 +58,16 @@ class Onebox:
         # reports its own counts in RecoveryReport instead
         from .rebuild import DeviceRebuilder
         self.rebuilder = DeviceRebuilder()
+        # one consistent-query registry for the cluster (shard movement
+        # within the box keeps waiters reachable)
+        from .query import QueryRegistry
+        self.query_registry = QueryRegistry()
 
     def _make_engine(self, shard) -> HistoryEngine:
         engine = HistoryEngine(shard, self.stores, self.clock)
         engine.replication_publisher_holder = self._publisher_holder
         engine.rebuilder = self.rebuilder
+        engine.queries = self.query_registry
         return engine
 
     def set_replication_publisher(self, publisher) -> None:

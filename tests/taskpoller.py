@@ -19,13 +19,33 @@ class TaskPoller:
         self.task_list = task_list
         self.deciders = deciders
 
+    def _answer_queries(self, resp) -> dict:
+        """Compute answers for queries attached to a poll response via the
+        decider's optional .query(query_type, history) hook."""
+        results = {}
+        for qid, qtype, _args in resp.queries:
+            wf = resp.execution[1] if resp.execution else None
+            decider = self.deciders.get(wf)
+            if decider is not None and hasattr(decider, "query"):
+                results[qid] = decider.query(qtype, resp.history)
+            else:
+                results[qid] = b""
+        return results
+
     def poll_and_decide_once(self) -> bool:
         resp = self.box.frontend.poll_for_decision_task(self.domain, self.task_list)
         if resp is None:
             return False
+        if resp.query_only:
+            # query-only task (no decision token): answer directly
+            for qid, result in self._answer_queries(resp).items():
+                self.box.frontend.respond_query_task_completed(
+                    resp.execution, qid, result)
+            return True
         decider = self.deciders[resp.token.workflow_id]
         decisions = decider.decide(resp.history)
-        self.box.frontend.respond_decision_task_completed(resp.token, decisions)
+        self.box.frontend.respond_decision_task_completed(
+            resp.token, decisions, query_results=self._answer_queries(resp))
         return True
 
     def poll_and_run_activity_once(self, fail: bool = False) -> bool:
