@@ -198,8 +198,8 @@ def open_durable_stores(path: str) -> Stores:
     return stores
 
 
-def recover_stores(path: str, verify_on_device: bool = True
-                   ) -> Tuple[Stores, RecoveryReport]:
+def recover_stores(path: str, verify_on_device: bool = True,
+                   layout=None) -> Tuple[Stores, RecoveryReport]:
     """Rebuild a cluster's stores from its write-ahead log.
 
     1. replay the log: domains, shard infos, history branches (appends +
@@ -258,7 +258,7 @@ def recover_stores(path: str, verify_on_device: bool = True
                     task=_repl_task_from(rec["p"]["task"]),
                     error=rec["p"]["err"]))
 
-    report = _rebuild_executions(stores, verify_on_device)
+    report = _rebuild_executions(stores, verify_on_device, layout)
     _reconcile_current_pointers(stores)
     # new writes continue the same log (records are idempotent to replay:
     # recovery takes the last pointer values and appends are per-branch
@@ -290,8 +290,8 @@ def _reconcile_current_pointers(stores: Stores) -> None:
                                                  close_status=info.close_status))
 
 
-def _rebuild_executions(stores: Stores, verify_on_device: bool
-                        ) -> RecoveryReport:
+def _rebuild_executions(stores: Stores, verify_on_device: bool,
+                        layout=None) -> RecoveryReport:
     from ..core.enums import WorkflowState
     from ..oracle.mutable_state import DomainEntry
     from .rebuild import DeviceRebuilder
@@ -316,7 +316,9 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool
     # one batched device replay rebuilds EVERY run's state in lockstep
     # (the bulk state_rebuilder); flagged rows fall back to the oracle,
     # counted in the report
-    rebuilder = DeviceRebuilder()
+    from ..core.checksum import DEFAULT_LAYOUT
+    layout = layout if layout is not None else DEFAULT_LAYOUT
+    rebuilder = DeviceRebuilder(layout)
     states = rebuilder.rebuild(jobs) if jobs else []
     report.device_rebuilt = rebuilder.stats.device
     report.rebuild_fallback = rebuilder.stats.oracle_fallback
@@ -343,7 +345,7 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool
 
     if verify_on_device and report.executions_rebuilt:
         from .tpu_engine import TPUReplayEngine
-        result = TPUReplayEngine(stores).verify_all()
+        result = TPUReplayEngine(stores, layout).verify_all()
         report.device_verified = result.verified_on_device
         report.oracle_fallback = len(result.fallback)
         report.divergent = result.divergent

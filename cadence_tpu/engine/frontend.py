@@ -52,14 +52,43 @@ class PollActivityResponse:
 
 class Frontend:
     def __init__(self, stores: Stores, matching: MatchingEngine,
-                 router: Callable[[str], HistoryEngine]) -> None:
+                 router: Callable[[str], HistoryEngine],
+                 config=None, metrics=None, time_source=None) -> None:
+        from ..utils import metrics as m
+        from ..utils.clock import RealTimeSource
+        from ..utils.dynamicconfig import (
+            KEY_FRONTEND_BURST,
+            KEY_FRONTEND_DOMAIN_RPS,
+            KEY_FRONTEND_RPS,
+            DynamicConfig,
+        )
+        from ..utils.quotas import MultiStageRateLimiter
         self.stores = stores
         self.matching = matching
         self.router = router
+        self.config = config if config is not None else DynamicConfig()
+        self.metrics = metrics if metrics is not None else m.DEFAULT_REGISTRY
+        clock = time_source if time_source is not None else RealTimeSource()
+        # the quotas seat (common/quotas/ratelimiter.go:43): global +
+        # per-domain token buckets with live-config limits; 0 = unlimited
+        self.rate_limiter = MultiStageRateLimiter(
+            clock,
+            global_rps=lambda: self.config.get(KEY_FRONTEND_RPS),
+            domain_rps=lambda d: self.config.get(KEY_FRONTEND_DOMAIN_RPS,
+                                                 domain=d),
+            burst=lambda: self.config.get(KEY_FRONTEND_BURST),
+        )
+
+    def _admit(self, domain: str, scope: str) -> None:
+        from ..utils import metrics as m
+        from ..utils.quotas import ServiceBusyError
+        if not self.rate_limiter.allow(domain):
+            self.metrics.inc(scope, m.M_RATE_LIMITED)
+            raise ServiceBusyError(f"domain {domain} over request limit")
 
     # -- domains (workflowHandler.go:265-437) ------------------------------
 
-    def register_domain(self, name: str, retention_days: int = 1,
+    def register_domain(self, name: str, retention_days: int = 0,
                         is_active: bool = True,
                         clusters: tuple = ("primary",),
                         active_cluster: str = "primary",
@@ -67,6 +96,9 @@ class Frontend:
                         domain_id: str = "") -> str:
         """Domain CRUD (workflowHandler.go:265). Global domains pass the same
         domain_id on every cluster (the domain-replication invariant)."""
+        from ..utils.dynamicconfig import KEY_RETENTION_DAYS_DEFAULT
+        if retention_days <= 0:
+            retention_days = int(self.config.get(KEY_RETENTION_DAYS_DEFAULT))
         domain_id = domain_id or str(uuid.uuid4())
         self.stores.domain.register(DomainInfo(
             domain_id=domain_id, name=name, retention_days=retention_days,
@@ -90,6 +122,9 @@ class Frontend:
                                  first_decision_backoff: int = 0,
                                  retry_policy: Optional[RetryPolicy] = None,
                                  ) -> str:
+        from ..utils import metrics as m
+        self._admit(domain, m.SCOPE_FRONTEND_START)
+        self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
         domain_id = self.stores.domain.by_name(domain).domain_id
         engine = self.router(workflow_id)
         return engine.start_workflow(
@@ -105,6 +140,8 @@ class Frontend:
     def signal_workflow_execution(self, domain: str, workflow_id: str,
                                   signal_name: str,
                                   run_id: Optional[str] = None) -> None:
+        from ..utils import metrics as m
+        self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         domain_id = self.stores.domain.by_name(domain).domain_id
         self.router(workflow_id).signal_workflow(domain_id, workflow_id,
                                                  signal_name, run_id)

@@ -91,6 +91,12 @@ class HistoryEngine:
         #: cluster replaces this with its shared instance
         from .query import QueryRegistry
         self.queries = QueryRegistry()
+        #: cluster metrics + dynamic config; the owning cluster replaces
+        #: these with its shared instances (onebox._make_engine)
+        from ..utils.dynamicconfig import DynamicConfig
+        from ..utils.metrics import DEFAULT_REGISTRY
+        self.metrics = DEFAULT_REGISTRY
+        self.config = DynamicConfig()
 
     def _replication_target(self, domain_id: str, ms: MutableState):
         """Shared gate for both replication publish paths: (publisher,
@@ -258,6 +264,9 @@ class HistoryEngine:
                 flushed_started[attrs.get("scheduled_event_id")] = real.id
             elif ev.event_type == EventType.ChildWorkflowExecutionStarted:
                 flushed_child_started[attrs.get("initiated_event_id")] = real.id
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_HISTORY_DECISION_COMPLETED,
+                         m.M_BUFFERED_FLUSHED, len(normal) + len(closes))
         return len(normal) + len(closes)
 
     # ------------------------------------------------------------------
@@ -278,6 +287,8 @@ class HistoryEngine:
                        initiator: Optional[ContinueAsNewInitiator] = None,
                        attempt: int = 0,
                        expiration_timestamp: int = 0) -> str:
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_HISTORY_START_WORKFLOW, m.M_REQUESTS)
         run_id = run_id or str(uuid.uuid4())
         ms = MutableState(self._domain_entry(domain_id))
         version = ms.domain_entry.failover_version
@@ -405,6 +416,8 @@ class HistoryEngine:
         decision dispatch to the worker's sticky task list; absent
         attributes clear stickyness (workflowHandler →
         historyEngine.go RespondDecisionTaskCompleted sticky handling)."""
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_HISTORY_DECISION_COMPLETED, m.M_REQUESTS)
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
         info = ms.execution_info
         if info.state == WorkflowState.Completed:
@@ -870,6 +883,8 @@ class HistoryEngine:
 
     def signal_workflow(self, domain_id: str, workflow_id: str,
                         signal_name: str, run_id: Optional[str] = None) -> None:
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_HISTORY_SIGNAL, m.M_REQUESTS)
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
         if self._has_inflight_decision(ms):
@@ -933,6 +948,8 @@ class HistoryEngine:
         with a reset cause, signals recorded after the reset point are
         re-applied (ndc/events_reapplier.go), and the new run becomes
         current; a still-running base run is terminated first."""
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_HISTORY_RESET, m.M_REQUESTS)
         base_ms, _ = self._load(domain_id, workflow_id, run_id)
         base_info = base_ms.execution_info
         run_id = base_info.run_id
@@ -962,7 +979,7 @@ class HistoryEngine:
         # device-first rebuild of the forked prefix (oracle fallback counted)
         from .rebuild import DeviceRebuilder
         if not hasattr(self, "rebuilder"):
-            self.rebuilder = DeviceRebuilder()
+            self.rebuilder = DeviceRebuilder(self.config.payload_layout())
         new_ms = self.rebuilder.rebuild_one(prefix, self._domain_entry(domain_id))
         new_ms.domain_entry = self._domain_entry(domain_id)
 

@@ -44,6 +44,7 @@ class ReplicatedClusters:
         self.processor = ReplicationTaskProcessor(
             self.replicator, self.publisher, self.standby.stores,
             source_history_reader=self._read_source_history)
+        self.processor.metrics = self.standby.metrics
         # reverse direction (standby → active): every cluster in an NDC
         # group both publishes and consumes (task_fetcher.go polls every
         # remote cluster); needed for post-split-brain reconciliation
@@ -55,6 +56,7 @@ class ReplicatedClusters:
             self.reverse_replicator, self.reverse_publisher,
             self.active.stores,
             source_history_reader=self._read_standby_history)
+        self.reverse_processor.metrics = self.active.metrics
 
     def _read_source_history(self, domain_id: str, workflow_id: str,
                              run_id: str, from_event_id: int,

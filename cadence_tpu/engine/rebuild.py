@@ -65,8 +65,10 @@ class DeviceRebuilder:
     """Batched device replay → full MutableState objects."""
 
     def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
+        from ..utils.metrics import DEFAULT_REGISTRY
         self.layout = layout
         self.stats = RebuildStats()
+        self.metrics = DEFAULT_REGISTRY
 
     def rebuild_one(self, batches: Sequence[HistoryBatch],
                     domain_entry: Optional[DomainEntry] = None) -> MutableState:
@@ -85,17 +87,25 @@ class DeviceRebuilder:
 
         if not jobs:
             return []
+        from ..utils import metrics as m
+        scope = self.metrics.scope(m.SCOPE_REBUILD)
         max_events = max(history_length(b) for b, _ in jobs)
         corpus = encode_corpus([b for b, _ in jobs], max_events)
-        state, _log = replay_events_with_tasks(jnp.asarray(corpus), self.layout)
-        rows = np.asarray(payload_rows(state, self.layout))
-        arrs = jax.device_get(state)
+        total_events = sum(history_length(b) for b, _ in jobs)
+        scope.inc(m.M_KERNEL_LAUNCHES)
+        scope.inc(m.M_EVENTS_REPLAYED, total_events)
+        with scope.timed():
+            state, _log = replay_events_with_tasks(jnp.asarray(corpus),
+                                                   self.layout)
+            rows = np.asarray(payload_rows(state, self.layout))
+            arrs = jax.device_get(state)
 
         out: List[MutableState] = []
         for i, (batches, entry) in enumerate(jobs):
             err = int(arrs.error[i])
             if err != 0:
                 self.stats.oracle_fallback += 1
+                scope.inc(m.M_ORACLE_FALLBACKS)
                 self.stats.kernel_errors[err] = (
                     self.stats.kernel_errors.get(err, 0) + 1)
                 out.append(self._oracle_rebuild(batches, entry))
@@ -105,10 +115,15 @@ class DeviceRebuilder:
                 # hydration must reproduce the device's canonical payload
                 # exactly; anything else routes through the oracle, counted
                 self.stats.oracle_fallback += 1
+                scope.inc(m.M_ORACLE_FALLBACKS)
                 out.append(self._oracle_rebuild(batches, entry))
                 continue
             self.stats.device += 1
+            scope.inc(m.M_DEVICE_REBUILDS)
             out.append(ms)
+        done = self.stats.device + self.stats.oracle_fallback
+        self.metrics.gauge(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
+                           (self.stats.oracle_fallback / done) if done else 0.0)
         return out
 
     @staticmethod

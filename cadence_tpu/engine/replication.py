@@ -491,6 +491,8 @@ class ReplicationTaskProcessor:
         self.applied = 0
         self.deduped = 0
         self.resends = 0
+        from ..utils.metrics import DEFAULT_REGISTRY
+        self.metrics = DEFAULT_REGISTRY
 
     def _apply_task(self, task) -> bool:
         """Dispatch by task type (replication/task_executor.go:80 execute)."""
@@ -499,16 +501,22 @@ class ReplicationTaskProcessor:
         return self.replicator.apply(task)
 
     def process_once(self, batch_size: int = 100) -> int:
+        from ..utils import metrics as m
+        scope = self.metrics.scope(m.SCOPE_REPLICATION)
         tasks = self.source.read_tasks(self.ack_index, batch_size)
         for index, task in tasks:
             try:
                 if self._apply_task(task):
                     self.applied += 1
+                    scope.inc(m.M_REPL_APPLIED)
                 else:
                     self.deduped += 1
+                    scope.inc(m.M_REPL_DEDUPED)
             except RetryReplicationError as gap:
+                scope.inc(m.M_REPL_RESENT)
                 self._resend(task, gap)
             except ReplayError as err:
+                scope.inc(m.M_REPL_DLQ)
                 self.stores.queue.enqueue(REPLICATION_DLQ,
                                           DLQEntry(task=task, error=str(err)))
             self.ack_index = index + 1

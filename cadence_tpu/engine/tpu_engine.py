@@ -54,8 +54,10 @@ class TPUReplayEngine:
 
     def __init__(self, stores: Stores,
                  layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
+        from ..utils.metrics import DEFAULT_REGISTRY
         self.stores = stores
         self.layout = layout
+        self.metrics = DEFAULT_REGISTRY
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [
@@ -106,11 +108,23 @@ class TPUReplayEngine:
         from ..ops.payload import payload_rows
         from ..ops.replay import replay_events
 
+        from ..utils import metrics as m
+        scope = self.metrics.scope(m.SCOPE_TPU_REPLAY)
         corpus = encode_segment_corpus([self.tree_segments(k) for k in keys])
-        state = replay_events(jnp.asarray(corpus), self.layout)
-        rows = payload_rows(state, self.layout)
-        return (np.asarray(rows), np.asarray(state.error),
-                np.asarray(state.current_branch))
+        real_events = int((corpus[:, :, 0] > 0).sum())
+        scope.inc(m.M_KERNEL_LAUNCHES)
+        scope.inc(m.M_EVENTS_REPLAYED, real_events)
+        with scope.timed() :
+            state = replay_events(jnp.asarray(corpus), self.layout)
+            rows = np.asarray(payload_rows(state, self.layout))
+            errors = np.asarray(state.error)
+        t = self.metrics.timer(m.SCOPE_TPU_REPLAY, m.M_LATENCY)
+        if t.total_s > 0:
+            self.metrics.gauge(
+                m.SCOPE_TPU_REPLAY, m.M_REPLAY_THROUGHPUT,
+                self.metrics.counter(m.SCOPE_TPU_REPLAY, m.M_EVENTS_REPLAYED)
+                / t.total_s)
+        return (rows, errors, np.asarray(state.current_branch))
 
     def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
                    ) -> BulkVerifyResult:

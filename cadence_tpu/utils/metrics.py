@@ -1,0 +1,153 @@
+"""Metrics: counters / timers / gauges behind named scopes.
+
+Reference: common/metrics (Client/Scope at metrics/interfaces.go:31,:53;
+every scope and metric name enumerated in metrics/defs.go). The reference
+emits through tally to m3/statsd/prometheus; here the registry keeps the
+aggregates in-process (snapshot() is the emitter seam — a prometheus
+text-format dump or a push client would read the same structure) so tests
+and the bench can assert on what the engine actually measured.
+
+Thread-safe; scopes are cheap handles over the shared registry.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+# -- scope names (metrics/defs.go analog; the subset the engine emits) ------
+
+SCOPE_HISTORY_START_WORKFLOW = "history.start-workflow-execution"
+SCOPE_HISTORY_DECISION_COMPLETED = "history.respond-decision-task-completed"
+SCOPE_HISTORY_ACTIVITY_RESPOND = "history.respond-activity-task"
+SCOPE_HISTORY_SIGNAL = "history.signal-workflow-execution"
+SCOPE_HISTORY_RESET = "history.reset-workflow-execution"
+SCOPE_FRONTEND_START = "frontend.start-workflow-execution"
+SCOPE_FRONTEND_SIGNAL = "frontend.signal-workflow-execution"
+SCOPE_QUEUE_TRANSFER = "queue.transfer"
+SCOPE_QUEUE_TIMER = "queue.timer"
+SCOPE_REPLICATION = "replication.task-processor"
+SCOPE_TPU_REPLAY = "tpu.replay-engine"
+SCOPE_REBUILD = "tpu.device-rebuilder"
+
+# -- metric names -----------------------------------------------------------
+
+M_REQUESTS = "requests"
+M_ERRORS = "errors"
+M_LATENCY = "latency"
+M_TASKS_PROCESSED = "tasks-processed"
+M_TASKS_DROPPED_NOT_EXISTS = "tasks-dropped-entity-not-exists"
+M_REPL_APPLIED = "replication-applied"
+M_REPL_DEDUPED = "replication-deduped"
+M_REPL_RESENT = "replication-resends"
+M_REPL_DLQ = "replication-dlq"
+M_KERNEL_LAUNCHES = "kernel-launches"
+M_EVENTS_REPLAYED = "events-replayed"
+M_REPLAY_THROUGHPUT = "replay-events-per-sec"
+M_DEVICE_REBUILDS = "device-rebuilds"
+M_ORACLE_FALLBACKS = "oracle-fallbacks"
+M_FALLBACK_RATE = "fallback-rate"
+M_BUFFERED_FLUSHED = "buffered-events-flushed"
+M_RATE_LIMITED = "requests-rate-limited"
+
+
+@dataclass
+class _TimerStat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+
+class MetricsRegistry:
+    """The tally-registry analog; one per cluster."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, str], int] = {}
+        self._timers: Dict[Tuple[str, str], _TimerStat] = {}
+        self._gauges: Dict[Tuple[str, str], float] = {}
+
+    def scope(self, name: str) -> "Scope":
+        return Scope(self, name)
+
+    # raw ops (scopes call these)
+
+    def inc(self, scope: str, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[(scope, name)] = (
+                self._counters.get((scope, name), 0) + delta)
+
+    def record(self, scope: str, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers.setdefault((scope, name), _TimerStat()).record(seconds)
+
+    def gauge(self, scope: str, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[(scope, name)] = value
+
+    # reads
+
+    def counter(self, scope: str, name: str) -> int:
+        with self._lock:
+            return self._counters.get((scope, name), 0)
+
+    def timer(self, scope: str, name: str) -> _TimerStat:
+        with self._lock:
+            return self._timers.get((scope, name), _TimerStat())
+
+    def gauge_value(self, scope: str, name: str,
+                    default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get((scope, name), default)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Full dump, grouped by scope — the emitter seam."""
+        out: Dict[str, Dict[str, object]] = {}
+        with self._lock:
+            for (scope, name), v in self._counters.items():
+                out.setdefault(scope, {})[name] = v
+            for (scope, name), t in self._timers.items():
+                out.setdefault(scope, {})[name + ".count"] = t.count
+                out.setdefault(scope, {})[name + ".total_s"] = round(t.total_s, 6)
+                out.setdefault(scope, {})[name + ".max_s"] = round(t.max_s, 6)
+            for (scope, name), v in self._gauges.items():
+                out.setdefault(scope, {})[name] = v
+        return out
+
+
+class Scope:
+    """One named scope (metrics.Scope analog)."""
+
+    def __init__(self, registry: MetricsRegistry, name: str) -> None:
+        self._r = registry
+        self.name = name
+
+    def inc(self, metric: str, delta: int = 1) -> None:
+        self._r.inc(self.name, metric, delta)
+
+    def record(self, metric: str, seconds: float) -> None:
+        self._r.record(self.name, metric, seconds)
+
+    def gauge(self, metric: str, value: float) -> None:
+        self._r.gauge(self.name, metric, value)
+
+    @contextmanager
+    def timed(self, metric: str = M_LATENCY):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._r.record(self.name, metric, time.perf_counter() - start)
+
+
+#: fallback registry for components constructed without explicit wiring
+#: (a cluster passes its own; the default keeps standalone use observable)
+DEFAULT_REGISTRY = MetricsRegistry()

@@ -1,0 +1,86 @@
+"""Rate limiting: token buckets over the engine's TimeSource.
+
+Reference: common/tokenbucket/tb.go + common/quotas/ratelimiter.go:43 and
+the per-domain collection (quotas/collection.go) / multi-stage limiter
+(quotas/multistageratelimiter.go). Built on the injected clock so tests
+with a ManualTimeSource get deterministic refill behavior.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .clock import TimeSource
+
+NANOS = 1_000_000_000
+
+
+class TokenBucket:
+    """Classic token bucket: `rps` refill, `burst` capacity."""
+
+    def __init__(self, clock: TimeSource, rps: float, burst: float = 0) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rps = float(rps)
+        self._burst = float(burst) if burst > 0 else float(rps)
+        self._tokens = self._burst
+        self._last = clock.now()
+
+    def allow(self, n: float = 1.0) -> bool:
+        """Consume n tokens if available (RateLimiter.Allow analog)."""
+        if self._rps <= 0:
+            return True  # unlimited
+        with self._lock:
+            now = self._clock.now()
+            elapsed = max(0, now - self._last) / NANOS
+            self._last = now
+            self._tokens = min(self._burst, self._tokens + elapsed * self._rps)
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class MultiStageRateLimiter:
+    """Global + per-domain stages: a request passes only if EVERY stage
+    admits it (quotas/multistageratelimiter.go). Limits come from live
+    config closures so updates apply without restarts."""
+
+    def __init__(self, clock: TimeSource,
+                 global_rps: Callable[[], int],
+                 domain_rps: Callable[[str], int],
+                 burst: Callable[[], int]) -> None:
+        self._clock = clock
+        self._global_rps = global_rps
+        self._domain_rps = domain_rps
+        self._burst = burst
+        self._lock = threading.Lock()
+        self._global: Optional[TokenBucket] = None
+        self._domains: Dict[str, TokenBucket] = {}
+        self._applied: Dict[str, float] = {}
+
+    def _bucket(self, key: str, rps: float) -> TokenBucket:
+        burst = float(self._burst() or rps)
+        with self._lock:
+            b = self._domains.get(key)
+            # rebuild on live limit OR burst changes (collection.go refresh)
+            if b is None or self._applied.get(key) != (rps, burst):
+                b = TokenBucket(self._clock, rps, burst)
+                self._domains[key] = b
+                self._applied[key] = (rps, burst)
+            return b
+
+    def allow(self, domain: str) -> bool:
+        # domain stage FIRST: a hot domain's rejections must not drain the
+        # global bucket for everyone else (multistageratelimiter.go order)
+        d = float(self._domain_rps(domain) or 0)
+        if d > 0 and not self._bucket(f"domain:{domain}", d).allow():
+            return False
+        g = float(self._global_rps() or 0)
+        if g > 0 and not self._bucket("", g).allow():
+            return False
+        return True
+
+
+class ServiceBusyError(Exception):
+    """Over-limit rejection (types.ServiceBusyError analog)."""

@@ -1,0 +1,146 @@
+"""Metrics + dynamic config + quotas (VERDICT ask #6).
+
+Reference analogs: common/metrics (defs.go scopes), common/dynamicconfig
+(~350 knobs consumed as closures), common/quotas/ratelimiter.go:43.
+"""
+import pytest
+
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider, SignalDecider
+from cadence_tpu.utils import metrics as m
+from cadence_tpu.utils.clock import ManualTimeSource
+from cadence_tpu.utils.dynamicconfig import (
+    KEY_FRONTEND_DOMAIN_RPS,
+    KEY_FRONTEND_RPS,
+    KEY_MAX_ACTIVITIES,
+    KEY_MAX_BRANCHES,
+    DynamicConfig,
+)
+from cadence_tpu.utils.quotas import ServiceBusyError, TokenBucket
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "metrics-domain"
+TL = "metrics-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+class TestMetrics:
+    def test_engine_transaction_counters_emit(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "m-1", "t", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"m-1": CompleteDecider()})
+        poller.drain()
+        assert box.metrics.counter(m.SCOPE_FRONTEND_START, m.M_REQUESTS) == 1
+        assert box.metrics.counter(m.SCOPE_HISTORY_START_WORKFLOW,
+                                   m.M_REQUESTS) == 1
+        assert box.metrics.counter(m.SCOPE_HISTORY_DECISION_COMPLETED,
+                                   m.M_REQUESTS) >= 1
+        assert box.metrics.counter(m.SCOPE_QUEUE_TRANSFER,
+                                   m.M_TASKS_PROCESSED) >= 1
+
+    def test_buffered_flush_counter(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "m-2", "signal", TL)
+        box.pump_once()
+        resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        box.frontend.signal_workflow_execution(DOMAIN, "m-2", "s")
+        box.frontend.respond_decision_task_completed(resp.token, [])
+        assert box.metrics.counter(m.SCOPE_HISTORY_DECISION_COMPLETED,
+                                   m.M_BUFFERED_FLUSHED) == 1
+
+    def test_replay_throughput_and_kernel_metrics_emit(self, box):
+        """verify_all records kernel launches, events replayed, and a
+        replay-throughput gauge (the VERDICT 'Done' criterion)."""
+        box.frontend.start_workflow_execution(DOMAIN, "m-3", "t", TL)
+        poller = TaskPoller(box, DOMAIN, TL, {"m-3": CompleteDecider()})
+        poller.drain()
+        assert box.tpu.verify_all().ok
+        assert box.metrics.counter(m.SCOPE_TPU_REPLAY, m.M_KERNEL_LAUNCHES) >= 1
+        assert box.metrics.counter(m.SCOPE_TPU_REPLAY, m.M_EVENTS_REPLAYED) > 0
+        assert box.metrics.gauge_value(m.SCOPE_TPU_REPLAY,
+                                       m.M_REPLAY_THROUGHPUT) > 0
+
+    def test_fallback_rate_gauge_emits(self, box):
+        """A reset runs the device rebuilder, which publishes the
+        fallback-rate gauge (0.0 when everything stayed on device)."""
+        box.frontend.start_workflow_execution(DOMAIN, "m-4", "signal", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"m-4": SignalDecider(expected_signals=2)})
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "m-4")
+        box.frontend.reset_workflow_execution(
+            DOMAIN, "m-4", decision_finish_event_id=4, run_id=run_id)
+        assert box.metrics.counter(m.SCOPE_REBUILD, m.M_DEVICE_REBUILDS) >= 1
+        assert box.metrics.gauge_value(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
+                                       default=-1.0) == 0.0
+        snap = box.metrics.snapshot()
+        assert m.SCOPE_REBUILD in snap and m.SCOPE_TPU_REPLAY not in ("",)
+
+
+class TestDynamicConfig:
+    def test_payload_layout_tunable_without_code_edits(self):
+        cfg = DynamicConfig({KEY_MAX_ACTIVITIES: 32, KEY_MAX_BRANCHES: 4})
+        box = Onebox(num_hosts=1, num_shards=2, config=cfg)
+        assert box.tpu.layout.max_activities == 32
+        assert box.tpu.layout.max_branches == 4
+        assert box.rebuilder.layout.max_activities == 32
+
+    def test_live_update_via_closure(self):
+        cfg = DynamicConfig()
+        prop = cfg.int_property(KEY_FRONTEND_RPS)
+        assert prop() == 0
+        cfg.set(KEY_FRONTEND_RPS, 7)
+        assert prop() == 7  # consumers see updates without rebuilds
+
+    def test_domain_filter_precedence(self):
+        cfg = DynamicConfig({KEY_FRONTEND_DOMAIN_RPS: 10})
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 3, domain="hot-domain")
+        assert cfg.get(KEY_FRONTEND_DOMAIN_RPS, domain="hot-domain") == 3
+        assert cfg.get(KEY_FRONTEND_DOMAIN_RPS, domain="other") == 10
+
+
+class TestQuotas:
+    def test_token_bucket_refills_with_clock(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=2, burst=2)
+        assert tb.allow() and tb.allow()
+        assert not tb.allow()  # burst exhausted
+        clock.advance(500_000_000)  # 0.5s → one token back
+        assert tb.allow()
+        assert not tb.allow()
+
+    def test_over_limit_start_rejected_cleanly(self):
+        cfg = DynamicConfig({KEY_FRONTEND_RPS: 2})
+        box = Onebox(num_hosts=1, num_shards=2, config=cfg)
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "q-1", "t", TL)
+        box.frontend.start_workflow_execution(DOMAIN, "q-2", "t", TL)
+        with pytest.raises(ServiceBusyError):
+            box.frontend.start_workflow_execution(DOMAIN, "q-3", "t", TL)
+        assert box.metrics.counter(m.SCOPE_FRONTEND_START,
+                                   m.M_RATE_LIMITED) == 1
+        # nothing was persisted for the rejected start
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        assert len([k for k in box.stores.execution.list_executions()
+                    if k[1] == "q-3"]) == 0
+        # tokens refill with time → admitted again
+        box.clock.advance(1_000_000_000)
+        box.frontend.start_workflow_execution(DOMAIN, "q-3", "t", TL)
+
+    def test_per_domain_limit(self):
+        cfg = DynamicConfig()
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 1, domain="limited")
+        box = Onebox(num_hosts=1, num_shards=2, config=cfg)
+        box.frontend.register_domain("limited")
+        box.frontend.register_domain("free")
+        box.frontend.start_workflow_execution("limited", "a", "t", TL)
+        with pytest.raises(ServiceBusyError):
+            box.frontend.start_workflow_execution("limited", "b", "t", TL)
+        # other domains unaffected
+        for i in range(5):
+            box.frontend.start_workflow_execution("free", f"f-{i}", "t", TL)
