@@ -2,23 +2,40 @@
 polling workers.
 
 Reference: service/matching/matchingEngine.go (AddDecisionTask:259,
-AddActivityTask:307, PollForDecisionTask:355, PollForActivityTask:459) and
-taskListManager.go (lease renewal :458, task ID blocks :485, sync-match
-fast path :530). Polls are non-blocking here (the onebox pump loop drives
-them); a poll either sync-matches a buffered task or returns None —
-long-poll parking is a transport concern, not a semantic one.
+AddActivityTask:307, PollForDecisionTask:355, PollForActivityTask:459,
+getAllPartitions:729) and taskListManager.go (lease renewal :458, task ID
+blocks :485, sync-match fast path :530) + forwarder.go:111 (partition →
+root forwarding).
+
+Round-3 fidelity:
+- **partitions**: a task list scales out as N partitions (root = the base
+  name, children = /__cadence_sys/<name>/<n>); adds and polls spread
+  round-robin (the reference hashes by caller identity — same goal:
+  de-hotspot the root);
+- **sync-match**: a PARKED poll rendezvouses with an incoming task
+  directly — no write-through, no backlog (trySyncMatch skips the
+  persistence round-trip entirely);
+- **forwarder**: a task added on a non-root partition whose local
+  partition has no parked poller forwards to the ROOT for sync-match
+  before persisting locally (ForwardTask); a poll that finds its
+  partition empty forwards to the root's backlog (ForwardPoll).
+
+Polls are non-blocking (the onebox pump loop drives them); long-poll
+transports park a ParkedPoll and get the sync-match callback instead.
 """
 from __future__ import annotations
 
 import threading
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from .persistence import PersistedTask, Stores, TaskListInfo
 
 TASK_LIST_TYPE_DECISION = 0
 TASK_LIST_TYPE_ACTIVITY = 1
+
+PARTITION_PREFIX = "/__cadence_sys/"
 
 
 @dataclass
@@ -34,8 +51,41 @@ class MatchedTask:
     query_id: str = ""
 
 
+class ParkedPoll:
+    """A parked long-poll awaiting sync-match (the poller side of
+    taskListManager.go:530 trySyncMatch). One-shot: a matched task lands
+    in .task; cancel() withdraws an unmatched park."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.task: Optional[MatchedTask] = None
+        self.done = threading.Event()
+        self._canceled = False
+
+    def _try_deliver(self, task: MatchedTask) -> bool:
+        with self._lock:
+            if self._canceled or self.task is not None:
+                return False
+            self.task = task
+        self.done.set()
+        return True
+
+    def cancel(self) -> bool:
+        """Withdraw (poll timeout); False if a task already matched."""
+        with self._lock:
+            if self.task is not None:
+                return False
+            self._canceled = True
+        return True
+
+
+def partition_name(base: str, partition: int) -> str:
+    """getAllPartitions naming (matchingEngine.go:729)."""
+    return base if partition == 0 else f"{PARTITION_PREFIX}{base}/{partition}"
+
+
 class _TaskListManager:
-    """One task list's buffering + lease (taskListManager.go analog)."""
+    """One PARTITION's buffering + lease (taskListManager.go analog)."""
 
     def __init__(self, stores: Stores, domain_id: str, name: str,
                  task_type: int) -> None:
@@ -47,8 +97,25 @@ class _TaskListManager:
         #: query-only tasks: transient, never persisted (a lost query is
         #: retried by the caller; the reference's query tasks are sync-only)
         self._query_buffer: Deque[tuple] = deque()
+        self._parked: Deque[ParkedPoll] = deque()
         self._next_task_id = self._info.range_id * 100000
         self._ack = 0
+
+    def try_sync_match(self, matched: MatchedTask) -> bool:
+        """Hand the task to a parked poller, skipping persistence
+        (taskListManager.go:530 trySyncMatch)."""
+        while True:
+            with self._lock:
+                if not self._parked:
+                    return False
+                poll = self._parked.popleft()
+            if poll._try_deliver(matched):
+                return True
+            # canceled park: discard and retry the next one
+
+    def park(self, poll: ParkedPoll) -> None:
+        with self._lock:
+            self._parked.append(poll)
 
     def add(self, domain_id: str, workflow_id: str, run_id: str,
             schedule_id: int) -> None:
@@ -89,10 +156,15 @@ class _TaskListManager:
 
 
 class MatchingEngine:
-    def __init__(self, stores: Stores) -> None:
+    def __init__(self, stores: Stores, config=None) -> None:
+        from ..utils.dynamicconfig import DynamicConfig
         self._stores = stores
+        self.config = config if config is not None else DynamicConfig()
         self._lock = threading.Lock()
         self._managers: Dict[Tuple[str, str, int], _TaskListManager] = {}
+        #: round-robin cursors per (domain, base, type) for add and poll
+        self._add_rr: Dict[Tuple[str, str, int], int] = {}
+        self._poll_rr: Dict[Tuple[str, str, int], int] = {}
 
     def _manager(self, domain_id: str, name: str, task_type: int
                  ) -> _TaskListManager:
@@ -104,35 +176,118 @@ class MatchingEngine:
                 self._managers[key] = mgr
             return mgr
 
+    def _num_partitions(self, base: str) -> int:
+        from ..utils.dynamicconfig import KEY_MATCHING_NUM_PARTITIONS
+        if base.startswith(PARTITION_PREFIX):
+            return 1  # already a partition name
+        return max(1, int(self.config.get(KEY_MATCHING_NUM_PARTITIONS)))
+
+    def _next_partition(self, rr: Dict, domain_id: str, base: str,
+                        task_type: int) -> int:
+        key = (domain_id, base, task_type)
+        with self._lock:
+            n = rr.get(key, 0)
+            rr[key] = n + 1
+        return n % self._num_partitions(base)
+
     # -- adds (called by transfer-queue executors) -------------------------
 
+    def _add_task(self, domain_id: str, base: str, task_type: int,
+                  workflow_id: str, run_id: str, schedule_id: int,
+                  partition: Optional[int] = None) -> None:
+        """AddDecisionTask/AddActivityTask: pick a partition, sync-match
+        locally, forward to root for sync-match, else persist locally."""
+        p = (self._next_partition(self._add_rr, domain_id, base, task_type)
+             if partition is None else partition)
+        matched = MatchedTask(domain_id=domain_id, workflow_id=workflow_id,
+                              run_id=run_id, schedule_id=schedule_id,
+                              task_list=base)
+        local = self._manager(domain_id, partition_name(base, p), task_type)
+        if local.try_sync_match(matched):
+            return
+        if p != 0:
+            # ForwardTask (forwarder.go:111): the root may have a parked
+            # poller even when this partition doesn't
+            root = self._manager(domain_id, base, task_type)
+            if root.try_sync_match(matched):
+                return
+        local.add(domain_id, workflow_id, run_id, schedule_id)
+
     def add_decision_task(self, domain_id: str, task_list: str,
-                          workflow_id: str, run_id: str, schedule_id: int) -> None:
-        self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).add(
-            domain_id, workflow_id, run_id, schedule_id)
+                          workflow_id: str, run_id: str, schedule_id: int,
+                          partition: Optional[int] = None) -> None:
+        self._add_task(domain_id, task_list, TASK_LIST_TYPE_DECISION,
+                       workflow_id, run_id, schedule_id, partition)
 
     def add_activity_task(self, domain_id: str, task_list: str,
-                          workflow_id: str, run_id: str, schedule_id: int) -> None:
-        self._manager(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY).add(
-            domain_id, workflow_id, run_id, schedule_id)
-
-    # -- polls (called by workers via frontend) ----------------------------
+                          workflow_id: str, run_id: str, schedule_id: int,
+                          partition: Optional[int] = None) -> None:
+        self._add_task(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY,
+                       workflow_id, run_id, schedule_id, partition)
 
     def add_query_task(self, domain_id: str, task_list: str,
                        workflow_id: str, run_id: str, query_id: str) -> None:
-        """Dispatch a query-only task (matchingEngine QueryWorkflow)."""
+        """Dispatch a query-only task (matchingEngine QueryWorkflow);
+        queries ride the ROOT partition."""
         self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION).add_query(
             domain_id, workflow_id, run_id, query_id)
 
+    # -- polls (called by workers via frontend) ----------------------------
+
+    def _poll_task(self, domain_id: str, base: str, task_type: int
+                   ) -> Optional[PersistedTask]:
+        """Pick a partition round-robin; an empty non-root partition
+        forwards the poll to the root's backlog (ForwardPoll)."""
+        p = self._next_partition(self._poll_rr, domain_id, base, task_type)
+        task = self._manager(domain_id, partition_name(base, p),
+                             task_type).poll()
+        if task is None and p != 0:
+            task = self._manager(domain_id, base, task_type).poll()
+        return task
+
+    def _park(self, domain_id: str, task_list: str, task_type: int,
+              partition: int) -> ParkedPoll:
+        """Register a parked long-poll on a partition; an incoming task
+        sync-matches into it (the poller arm of trySyncMatch).
+
+        The backlog is drained FIRST — the partition's, then the root's
+        (ForwardPoll) — so a park never waits while persisted work is
+        available (and a task landing between a missed poll and the park
+        can't be lost)."""
+        poll = ParkedPoll()
+        mgr = self._manager(domain_id, partition_name(task_list, partition),
+                            task_type)
+        task = mgr.poll()
+        if task is None and partition != 0:
+            task = self._manager(domain_id, task_list, task_type).poll()
+        if task is not None:
+            poll._try_deliver(MatchedTask(
+                domain_id=task.domain_id, workflow_id=task.workflow_id,
+                run_id=task.run_id, schedule_id=task.schedule_id,
+                task_list=task_list))
+            return poll
+        mgr.park(poll)
+        return poll
+
+    def park_for_decision_task(self, domain_id: str, task_list: str,
+                               partition: int = 0) -> ParkedPoll:
+        return self._park(domain_id, task_list, TASK_LIST_TYPE_DECISION,
+                          partition)
+
+    def park_for_activity_task(self, domain_id: str, task_list: str,
+                               partition: int = 0) -> ParkedPoll:
+        return self._park(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY,
+                          partition)
+
     def poll_for_decision_task(self, domain_id: str, task_list: str
                                ) -> Optional[MatchedTask]:
-        mgr = self._manager(domain_id, task_list, TASK_LIST_TYPE_DECISION)
-        q = mgr.poll_query()
+        q = self._manager(domain_id, task_list,
+                          TASK_LIST_TYPE_DECISION).poll_query()
         if q is not None:
             return MatchedTask(domain_id=q[0], workflow_id=q[1], run_id=q[2],
                                schedule_id=-1, task_list=task_list,
                                query_id=q[3])
-        task = mgr.poll()
+        task = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_DECISION)
         if task is None:
             return None
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
@@ -141,7 +296,7 @@ class MatchingEngine:
 
     def poll_for_activity_task(self, domain_id: str, task_list: str
                                ) -> Optional[MatchedTask]:
-        task = self._manager(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY).poll()
+        task = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY)
         if task is None:
             return None
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
@@ -150,9 +305,19 @@ class MatchingEngine:
 
     def describe_task_list(self, domain_id: str, task_list: str,
                            task_type: int) -> Dict[str, int]:
-        mgr = self._manager(domain_id, task_list, task_type)
-        return {"backlog": mgr.backlog()}
+        """DescribeTaskList (workflowHandler.go:3593): aggregate over the
+        base name's partitions."""
+        total = 0
+        for p in range(self._num_partitions(task_list)):
+            key = (domain_id, partition_name(task_list, p), task_type)
+            with self._lock:
+                mgr = self._managers.get(key)
+            if mgr is not None:
+                total += mgr.backlog()
+        return {"backlog": total,
+                "partitions": self._num_partitions(task_list)}
 
     def backlog(self) -> int:
         with self._lock:
-            return sum(m.backlog() for m in self._managers.values())
+            managers = list(self._managers.values())
+        return sum(m.backlog() for m in managers)

@@ -49,7 +49,7 @@ class Onebox:
                                engine_factory=self._make_engine)
             for h in self.hosts
         }
-        self.matching = MatchingEngine(self.stores)
+        self.matching = MatchingEngine(self.stores, config=self.config)
         self.processors = [
             QueueProcessors(c, self.matching, self.stores, self.clock,
                             router=self.route, metrics=self.metrics,
@@ -111,7 +111,8 @@ class Onebox:
         self.processors.append(QueueProcessors(controller, self.matching,
                                                self.stores, self.clock,
                                                router=self.route,
-                                               metrics=self.metrics))
+                                               metrics=self.metrics,
+                                               config=self.config))
         self.ring.add_member(name)
 
     def remove_host(self, name: str) -> None:

@@ -22,6 +22,7 @@ from ..core.enums import (
 )
 from ..oracle.mutable_state import GeneratedTask
 from ..utils.clock import TimeSource
+from ..utils.metrics import SCOPE_QUEUE_TIMER, SCOPE_QUEUE_TRANSFER
 from .history_engine import InvalidRequestError
 from .matching import MatchingEngine
 from .persistence import EntityNotExistsError, Stores
@@ -124,7 +125,7 @@ class QueueProcessors:
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.transfer")
+            self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
             return
         self.stores.visibility.record_started(VisibilityRecord(
             domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
@@ -138,7 +139,7 @@ class QueueProcessors:
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.transfer")
+            self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
             return
         info = ms.execution_info
         self.stores.visibility.record_closed(
@@ -155,7 +156,7 @@ class QueueProcessors:
                         info.parent_domain_id, info.parent_workflow_id,
                         info.parent_run_id, info.initiated_id, close_event)
                 except EntityNotExistsError:
-                    self._dropped_not_exists("queue.transfer")
+                    self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
 
     def _start_child(self, engine: "HistoryEngine", domain_id: str,
                      workflow_id: str, run_id: str, task: GeneratedTask) -> None:
@@ -164,7 +165,7 @@ class QueueProcessors:
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.transfer")
+            self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
             return
         ci = ms.pending_child_execution_info_ids.get(task.event_id)
         if ci is None:
@@ -200,7 +201,7 @@ class QueueProcessors:
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.transfer")
+            self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
             return
         si = ms.pending_signal_info_ids.get(task.event_id)
         if si is None:
@@ -223,7 +224,7 @@ class QueueProcessors:
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.transfer")
+            self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
             return
         if task.event_id not in ms.pending_request_cancel_info_ids:
             return
@@ -292,7 +293,7 @@ class QueueProcessors:
                 self._dispatch_activity_retry(domain_id, workflow_id, run_id,
                                               task)
         except EntityNotExistsError:
-            self._dropped_not_exists("queue.timer")
+            self._dropped_not_exists(SCOPE_QUEUE_TIMER)
 
     def _dispatch_activity_retry(self, domain_id: str, workflow_id: str,
                                  run_id: str, task: GeneratedTask) -> None:

@@ -27,6 +27,8 @@ KEY_MAX_VERSION_HISTORY_ITEMS = "kernel.maxVersionHistoryItems"
 KEY_MAX_BRANCHES = "kernel.maxVersionHistoryBranches"
 # engine / queues
 KEY_QUEUE_BATCH_SIZE = "history.queueBatchSize"
+# matching scale-out (matchingEngine.getAllPartitions / forwarder.go)
+KEY_MATCHING_NUM_PARTITIONS = "matching.numTasklistPartitions"
 KEY_RETENTION_DAYS_DEFAULT = "domain.defaultRetentionDays"
 # frontend quotas (quotas/ratelimiter.go seat)
 KEY_FRONTEND_RPS = "frontend.rps"
@@ -42,6 +44,7 @@ _DEFAULTS: Dict[str, Any] = {
     KEY_MAX_VERSION_HISTORY_ITEMS: 8,
     KEY_MAX_BRANCHES: 2,
     KEY_QUEUE_BATCH_SIZE: 100,
+    KEY_MATCHING_NUM_PARTITIONS: 1,
     KEY_RETENTION_DAYS_DEFAULT: 1,
     KEY_FRONTEND_RPS: 0,          # 0 = unlimited
     KEY_FRONTEND_DOMAIN_RPS: 0,

@@ -8,7 +8,7 @@ with a ManualTimeSource get deterministic refill behavior.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Tuple
 
 from .clock import TimeSource
 
@@ -55,9 +55,9 @@ class MultiStageRateLimiter:
         self._domain_rps = domain_rps
         self._burst = burst
         self._lock = threading.Lock()
-        self._global: Optional[TokenBucket] = None
+        #: buckets keyed by "" (global stage) or "domain:<name>"
         self._domains: Dict[str, TokenBucket] = {}
-        self._applied: Dict[str, float] = {}
+        self._applied: Dict[str, Tuple[float, float]] = {}
 
     def _bucket(self, key: str, rps: float) -> TokenBucket:
         burst = float(self._burst() or rps)

@@ -1,0 +1,138 @@
+"""Matching fidelity: sync-match + partitions + forwarder (VERDICT ask #7).
+
+Reference: taskListManager.go:530 trySyncMatch, forwarder.go:111,
+matchingEngine.go:729 getAllPartitions.
+"""
+import pytest
+
+from cadence_tpu.engine.matching import PARTITION_PREFIX, partition_name
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider, EchoDecider
+from cadence_tpu.utils.dynamicconfig import (
+    KEY_MATCHING_NUM_PARTITIONS,
+    DynamicConfig,
+)
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "match-domain"
+TL = "match-tl"
+
+
+def make_box(partitions: int = 1) -> Onebox:
+    cfg = DynamicConfig({KEY_MATCHING_NUM_PARTITIONS: partitions})
+    b = Onebox(num_hosts=1, num_shards=4, config=cfg)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+class TestSyncMatch:
+    def test_parked_poll_rendezvous_skips_persistence(self):
+        """A task added while a poll is parked hands off directly: no
+        write-through, no backlog (trySyncMatch)."""
+        box = make_box()
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        parked = box.matching.park_for_decision_task(domain_id, TL)
+        assert parked.task is None
+
+        box.matching.add_decision_task(domain_id, TL, "wf-1", "run-1", 2)
+        assert parked.task is not None
+        assert parked.task.workflow_id == "wf-1"
+        assert parked.task.schedule_id == 2
+        # nothing persisted, nothing buffered
+        assert box.matching.backlog() == 0
+        assert box.stores.task.get_tasks(domain_id, TL, 0, 0, 10_000) == []
+
+    def test_canceled_park_falls_through_to_backlog(self):
+        box = make_box()
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        parked = box.matching.park_for_decision_task(domain_id, TL)
+        assert parked.cancel()
+        box.matching.add_decision_task(domain_id, TL, "wf-1", "run-1", 2)
+        # canceled park is skipped; the task persists in the backlog
+        assert box.matching.backlog() == 1
+        task = box.matching.poll_for_decision_task(domain_id, TL)
+        assert task is not None and task.workflow_id == "wf-1"
+
+    def test_activity_sync_match(self):
+        box = make_box()
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        parked = box.matching.park_for_activity_task(domain_id, TL)
+        box.matching.add_activity_task(domain_id, TL, "wf-1", "run-1", 5)
+        assert parked.task is not None and parked.task.schedule_id == 5
+
+
+class TestPartitionsAndForwarder:
+    def test_nonroot_add_forwards_to_root_parked_poller(self):
+        """The VERDICT 'Done' case: a task added on a NON-ROOT partition
+        reaches a poller parked on the ROOT (ForwardTask sync-match)."""
+        box = make_box(partitions=4)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        parked_root = box.matching.park_for_decision_task(domain_id, TL,
+                                                          partition=0)
+        box.matching.add_decision_task(domain_id, TL, "wf-1", "run-1", 2,
+                                       partition=3)
+        assert parked_root.task is not None
+        assert parked_root.task.workflow_id == "wf-1"
+        assert box.matching.backlog() == 0
+
+    def test_local_parked_poller_wins_before_forwarding(self):
+        box = make_box(partitions=4)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        parked_local = box.matching.park_for_decision_task(domain_id, TL,
+                                                           partition=2)
+        parked_root = box.matching.park_for_decision_task(domain_id, TL,
+                                                          partition=0)
+        box.matching.add_decision_task(domain_id, TL, "wf-1", "run-1", 2,
+                                       partition=2)
+        assert parked_local.task is not None
+        assert parked_root.task is None
+
+    def test_poll_forwards_to_root_backlog(self):
+        """A poll landing on an empty partition drains the root's backlog
+        (ForwardPoll)."""
+        box = make_box(partitions=3)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        # park nothing; add straight to the root partition's backlog
+        box.matching.add_decision_task(domain_id, TL, "wf-1", "run-1", 2,
+                                       partition=0)
+        # polls round-robin over partitions; every poll either hits the
+        # root directly or forwards to it — the task comes back within the
+        # partition count
+        got = None
+        for _ in range(3):
+            got = box.matching.poll_for_decision_task(domain_id, TL)
+            if got:
+                break
+        assert got is not None and got.workflow_id == "wf-1"
+
+    def test_partition_names(self):
+        assert partition_name(TL, 0) == TL
+        assert partition_name(TL, 2) == f"{PARTITION_PREFIX}{TL}/2"
+
+    def test_backlog_drains_with_partitions_enabled(self):
+        """End-to-end workflows complete with a partitioned task list
+        (adds and polls spread over partitions; drain covers them all)."""
+        box = make_box(partitions=4)
+        for i in range(6):
+            box.frontend.start_workflow_execution(DOMAIN, f"wf-{i}", "echo", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {f"wf-{i}": EchoDecider(TL) for i in range(6)})
+        poller.drain()
+        from cadence_tpu.core.enums import CloseStatus
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for i in range(6):
+            run = box.stores.execution.get_current_run_id(domain_id, f"wf-{i}")
+            ms = box.stores.execution.get_workflow(domain_id, f"wf-{i}", run)
+            assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.matching.backlog() == 0
+        assert box.tpu.verify_all().ok
+
+    def test_describe_task_list_aggregates_partitions(self):
+        box = make_box(partitions=3)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for p in range(3):
+            box.matching.add_decision_task(domain_id, TL, f"wf-{p}", "r", 2,
+                                           partition=p)
+        desc = box.matching.describe_task_list(domain_id, TL, 0)
+        assert desc["backlog"] == 3
+        assert desc["partitions"] == 3
