@@ -172,10 +172,21 @@ class Frontend:
 
     # -- worker polls ------------------------------------------------------
 
-    def poll_for_decision_task(self, domain: str, task_list: str
+    def poll_for_decision_task(self, domain: str, task_list: str,
+                               wait_seconds: float = 0
                                ) -> Optional[PollDecisionResponse]:
+        """PollForDecisionTask (workflowHandler.go:580). With
+        `wait_seconds` > 0 the poll LONG-POLLS: an empty task list parks
+        the poll for sync-match instead of returning immediately (the
+        reference's long-poll transport over taskListManager's matcher)."""
         domain_id = self.stores.domain.by_name(domain).domain_id
         task = self.matching.poll_for_decision_task(domain_id, task_list)
+        if task is None and wait_seconds > 0:
+            parked = self.matching.park_for_decision_task(domain_id, task_list)
+            parked.done.wait(wait_seconds)
+            if parked.task is None:
+                parked.cancel()
+            task = parked.task
         if task is None:
             return None
         engine = self.router(task.workflow_id)
@@ -293,10 +304,17 @@ class Frontend:
         """Answer a query-only task (RespondQueryTaskCompleted analog)."""
         self.router(execution[1]).queries.complete(execution, query_id, result)
 
-    def poll_for_activity_task(self, domain: str, task_list: str
+    def poll_for_activity_task(self, domain: str, task_list: str,
+                               wait_seconds: float = 0
                                ) -> Optional[PollActivityResponse]:
         domain_id = self.stores.domain.by_name(domain).domain_id
         task = self.matching.poll_for_activity_task(domain_id, task_list)
+        if task is None and wait_seconds > 0:
+            parked = self.matching.park_for_activity_task(domain_id, task_list)
+            parked.done.wait(wait_seconds)
+            if parked.task is None:
+                parked.cancel()
+            task = parked.task
         if task is None:
             return None
         engine = self.router(task.workflow_id)
@@ -325,10 +343,29 @@ class Frontend:
     # -- reads -------------------------------------------------------------
 
     def get_workflow_execution_history(self, domain: str, workflow_id: str,
-                                       run_id: Optional[str] = None
+                                       run_id: Optional[str] = None,
+                                       wait_for_new_event: bool = False,
+                                       last_event_id: int = 0,
+                                       timeout: float = 10.0
                                        ) -> List[HistoryEvent]:
+        """GetWorkflowExecutionHistory (workflowHandler.go:2106). With
+        `wait_for_new_event`, the call LONG-POLLS: it blocks on the history
+        notifier until events beyond `last_event_id` exist or the workflow
+        closes (the reference's close-event wait policy), instead of
+        busy-reading."""
         domain_id = self.stores.domain.by_name(domain).domain_id
-        return self.router(workflow_id).get_history(domain_id, workflow_id, run_id)
+        engine = self.router(workflow_id)
+        if run_id is None:
+            run_id = self.stores.execution.get_current_run_id(domain_id,
+                                                              workflow_id)
+        events = engine.get_history(domain_id, workflow_id, run_id)
+        if wait_for_new_event and (not events or events[-1].id <= last_event_id):
+            # an event BEYOND last_event_id exists iff the published
+            # next_event_id reaches last_event_id + 2
+            engine.notifier.wait_for((domain_id, workflow_id, run_id),
+                                     last_event_id + 2, timeout=timeout)
+            events = engine.get_history(domain_id, workflow_id, run_id)
+        return events
 
     def describe_workflow_execution(self, domain: str, workflow_id: str,
                                     run_id: Optional[str] = None

@@ -97,6 +97,10 @@ class HistoryEngine:
         from ..utils.metrics import DEFAULT_REGISTRY
         self.metrics = DEFAULT_REGISTRY
         self.config = DynamicConfig()
+        #: history long-poll pub/sub (events/notifier.go); the owning
+        #: cluster replaces this with its shared instance
+        from .notifier import HistoryNotifier
+        self.notifier = HistoryNotifier()
 
     def _replication_target(self, domain_id: str, ms: MutableState):
         """Shared gate for both replication publish paths: (publisher,
@@ -346,6 +350,8 @@ class HistoryEngine:
                                 ms.transfer_tasks, ms.timer_tasks)
         ms.transfer_tasks, ms.timer_tasks = [], []
         self._publish_replication(domain_id, workflow_id, run_id, events, ms)
+        self.notifier.notify((domain_id, workflow_id, run_id),
+                             ms.execution_info.next_event_id, False)
         return run_id
 
     # ------------------------------------------------------------------
@@ -1028,6 +1034,8 @@ class HistoryEngine:
                                 transfer, timer)
         self._publish_replication(domain_id, workflow_id, new_run_id,
                                   txn.events, new_ms)
+        self.notifier.notify((domain_id, workflow_id, new_run_id),
+                             new_ms.execution_info.next_event_id, False)
         return new_run_id
 
     # ------------------------------------------------------------------
@@ -1393,5 +1401,10 @@ class _Txn:
             new_transfer, new_timer)
         self.engine._publish_replication(info.domain_id, info.workflow_id,
                                          info.run_id, self.events, self.ms)
+        # wake history long-polls (events/notifier.go NotifyNewHistoryEvent)
+        from ..core.enums import WorkflowState as _WS
+        self.engine.notifier.notify(
+            (info.domain_id, info.workflow_id, info.run_id),
+            info.next_event_id, info.state == _WS.Completed)
         for fn in self._post:
             fn()

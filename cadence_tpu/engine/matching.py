@@ -61,6 +61,8 @@ class ParkedPoll:
         self.task: Optional[MatchedTask] = None
         self.done = threading.Event()
         self._canceled = False
+        #: set by the parking manager; removes this entry from its deque
+        self._unpark = None
 
     def _try_deliver(self, task: MatchedTask) -> bool:
         with self._lock:
@@ -71,11 +73,15 @@ class ParkedPoll:
         return True
 
     def cancel(self) -> bool:
-        """Withdraw (poll timeout); False if a task already matched."""
+        """Withdraw (poll timeout); False if a task already matched. The
+        entry leaves the manager's parked deque immediately — an idle task
+        list must not accumulate dead parks."""
         with self._lock:
             if self.task is not None:
                 return False
             self._canceled = True
+        if self._unpark is not None:
+            self._unpark()
         return True
 
 
@@ -101,25 +107,62 @@ class _TaskListManager:
         self._next_task_id = self._info.range_id * 100000
         self._ack = 0
 
-    def try_sync_match(self, matched: MatchedTask) -> bool:
-        """Hand the task to a parked poller, skipping persistence
-        (taskListManager.go:530 trySyncMatch)."""
-        while True:
-            with self._lock:
-                if not self._parked:
-                    return False
-                poll = self._parked.popleft()
+    def _sync_match_locked(self, matched: MatchedTask) -> bool:
+        while self._parked:
+            poll = self._parked.popleft()
             if poll._try_deliver(matched):
                 return True
             # canceled park: discard and retry the next one
+        return False
 
-    def park(self, poll: ParkedPoll) -> None:
+    def try_sync_match(self, matched: MatchedTask) -> bool:
+        """Hand the task to a parked poller, skipping persistence
+        (taskListManager.go:530 trySyncMatch)."""
         with self._lock:
+            return self._sync_match_locked(matched)
+
+    def park_or_take(self, poll: ParkedPoll, base: str,
+                     fallback: Optional["_TaskListManager"] = None) -> None:
+        """ATOMIC drain-or-park: under the lock, deliver a backlog task
+        (own, then the root's via `fallback` — ForwardPoll) into the poll,
+        or register the park. Atomicity closes the gap where a task lands
+        between a missed poll and the park and sleeps the full long-poll
+        timeout. Lock order is always child → root, never the reverse."""
+        with self._lock:
+            task = self._pop_locked()
+            if task is None and fallback is not None:
+                task = fallback.poll()
+            if task is not None:
+                poll._try_deliver(MatchedTask(
+                    domain_id=task.domain_id, workflow_id=task.workflow_id,
+                    run_id=task.run_id, schedule_id=task.schedule_id,
+                    task_list=base))
+                return
             self._parked.append(poll)
+            poll._unpark = lambda: self._remove_parked(poll)
+
+    def _remove_parked(self, poll: ParkedPoll) -> None:
+        with self._lock:
+            try:
+                self._parked.remove(poll)
+            except ValueError:
+                pass
 
     def add(self, domain_id: str, workflow_id: str, run_id: str,
-            schedule_id: int) -> None:
+            schedule_id: int, base: Optional[str] = None,
+            forward_to: Optional["_TaskListManager"] = None) -> None:
+        """Sync-match-or-persist ATOMICALLY under the lock: a parked local
+        poller gets the task directly (no write-through); otherwise the
+        root (`forward_to`, ForwardTask) may sync-match it; otherwise it
+        persists to the local backlog. Lock order child → root only."""
+        matched = MatchedTask(domain_id=domain_id, workflow_id=workflow_id,
+                              run_id=run_id, schedule_id=schedule_id,
+                              task_list=base or self._info.name)
         with self._lock:
+            if self._sync_match_locked(matched):
+                return
+            if forward_to is not None and forward_to.try_sync_match(matched):
+                return
             self._next_task_id += 1
             task = PersistedTask(task_id=self._next_task_id, domain_id=domain_id,
                                  workflow_id=workflow_id, run_id=run_id,
@@ -129,20 +172,30 @@ class _TaskListManager:
             self._stores.task.create_tasks(self._info, [task])
             self._buffer.append(task)
 
+    def _pop_locked(self) -> Optional[PersistedTask]:
+        if not self._buffer:
+            return None
+        task = self._buffer.popleft()
+        self._ack = task.task_id
+        self._stores.task.complete_tasks_less_than(
+            self._info.domain_id, self._info.name, self._info.task_type,
+            self._ack)
+        return task
+
     def poll(self) -> Optional[PersistedTask]:
         with self._lock:
-            if not self._buffer:
-                return None
-            task = self._buffer.popleft()
-            self._ack = task.task_id
-            self._stores.task.complete_tasks_less_than(
-                self._info.domain_id, self._info.name, self._info.task_type,
-                self._ack)
-            return task
+            return self._pop_locked()
 
     def add_query(self, domain_id: str, workflow_id: str, run_id: str,
                   query_id: str) -> None:
+        """Queries sync-match a parked decision poller like any other
+        decision task; otherwise they buffer (never persisted)."""
+        matched = MatchedTask(domain_id=domain_id, workflow_id=workflow_id,
+                              run_id=run_id, schedule_id=-1,
+                              task_list=self._info.name, query_id=query_id)
         with self._lock:
+            if self._sync_match_locked(matched):
+                return
             self._query_buffer.append((domain_id, workflow_id, run_id,
                                        query_id))
 
@@ -199,19 +252,13 @@ class MatchingEngine:
         locally, forward to root for sync-match, else persist locally."""
         p = (self._next_partition(self._add_rr, domain_id, base, task_type)
              if partition is None else partition)
-        matched = MatchedTask(domain_id=domain_id, workflow_id=workflow_id,
-                              run_id=run_id, schedule_id=schedule_id,
-                              task_list=base)
         local = self._manager(domain_id, partition_name(base, p), task_type)
-        if local.try_sync_match(matched):
-            return
-        if p != 0:
-            # ForwardTask (forwarder.go:111): the root may have a parked
-            # poller even when this partition doesn't
-            root = self._manager(domain_id, base, task_type)
-            if root.try_sync_match(matched):
-                return
-        local.add(domain_id, workflow_id, run_id, schedule_id)
+        # ForwardTask (forwarder.go:111): the root may have a parked poller
+        # even when this partition doesn't; sync-or-persist is atomic
+        # inside the manager
+        root = (self._manager(domain_id, base, task_type) if p != 0 else None)
+        local.add(domain_id, workflow_id, run_id, schedule_id, base=base,
+                  forward_to=root)
 
     def add_decision_task(self, domain_id: str, task_list: str,
                           workflow_id: str, run_id: str, schedule_id: int,
@@ -237,12 +284,25 @@ class MatchingEngine:
     def _poll_task(self, domain_id: str, base: str, task_type: int
                    ) -> Optional[PersistedTask]:
         """Pick a partition round-robin; an empty non-root partition
-        forwards the poll to the root's backlog (ForwardPoll)."""
+        forwards the poll to the root's backlog (ForwardPoll). As a last
+        resort, sweep every EXISTING partition manager of this base — so
+        tasks persisted on partitions beyond a lowered partition-count
+        knob still drain instead of stranding."""
         p = self._next_partition(self._poll_rr, domain_id, base, task_type)
         task = self._manager(domain_id, partition_name(base, p),
                              task_type).poll()
         if task is None and p != 0:
             task = self._manager(domain_id, base, task_type).poll()
+        if task is None:
+            prefix = f"{PARTITION_PREFIX}{base}/"
+            with self._lock:
+                candidates = [mgr for (d, name, t), mgr in self._managers.items()
+                              if d == domain_id and t == task_type
+                              and (name == base or name.startswith(prefix))]
+            for mgr in candidates:
+                task = mgr.poll()
+                if task is not None:
+                    break
         return task
 
     def _park(self, domain_id: str, task_list: str, task_type: int,
@@ -257,16 +317,9 @@ class MatchingEngine:
         poll = ParkedPoll()
         mgr = self._manager(domain_id, partition_name(task_list, partition),
                             task_type)
-        task = mgr.poll()
-        if task is None and partition != 0:
-            task = self._manager(domain_id, task_list, task_type).poll()
-        if task is not None:
-            poll._try_deliver(MatchedTask(
-                domain_id=task.domain_id, workflow_id=task.workflow_id,
-                run_id=task.run_id, schedule_id=task.schedule_id,
-                task_list=task_list))
-            return poll
-        mgr.park(poll)
+        root = (self._manager(domain_id, task_list, task_type)
+                if partition != 0 else None)
+        mgr.park_or_take(poll, task_list, fallback=root)
         return poll
 
     def park_for_decision_task(self, domain_id: str, task_list: str,

@@ -40,7 +40,8 @@ class ReplicatedClusters:
         self.publisher = ReplicationPublisher(self.active.stores)
         self.active.set_replication_publisher(self.publisher)
         self.replicator = HistoryReplicator(self.standby.stores,
-                                            rebuilder=self.standby.rebuilder)
+                                            rebuilder=self.standby.rebuilder,
+                                            notifier=self.standby.notifier)
         self.processor = ReplicationTaskProcessor(
             self.replicator, self.publisher, self.standby.stores,
             source_history_reader=self._read_source_history)
@@ -51,7 +52,8 @@ class ReplicatedClusters:
         self.reverse_publisher = ReplicationPublisher(self.standby.stores)
         self.standby.set_replication_publisher(self.reverse_publisher)
         self.reverse_replicator = HistoryReplicator(
-            self.active.stores, rebuilder=self.active.rebuilder)
+            self.active.stores, rebuilder=self.active.rebuilder,
+            notifier=self.active.notifier)
         self.reverse_processor = ReplicationTaskProcessor(
             self.reverse_replicator, self.reverse_publisher,
             self.active.stores,

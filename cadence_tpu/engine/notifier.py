@@ -1,0 +1,47 @@
+"""History event notifier: pub/sub that wakes long-poll waiters.
+
+Reference: service/history/events/notifier.go:43-48 — every committed
+transaction publishes (execution, next event ID, close status); frontend
+GetWorkflowExecutionHistory long-polls block on it instead of busy-reading
+(workflowHandler.go:2106 → history long-poll loop).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+class HistoryNotifier:
+    """Per-cluster notifier keyed by (domain_id, workflow_id, run_id)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        #: latest published (next_event_id, workflow_closed) per execution
+        self._latest: Dict[Tuple[str, str, str], Tuple[int, bool]] = {}
+
+    def notify(self, key: Tuple[str, str, str], next_event_id: int,
+               closed: bool) -> None:
+        """NotifyNewHistoryEvent (historyEngine commit hook)."""
+        with self._cond:
+            cur = self._latest.get(key)
+            if cur is None or next_event_id >= cur[0]:
+                self._latest[key] = (next_event_id, closed or
+                                     (cur[1] if cur else False))
+            self._cond.notify_all()
+
+    def wait_for(self, key: Tuple[str, str, str], min_next_event_id: int,
+                 timeout: float = 10.0) -> bool:
+        """Block until the execution's history reaches min_next_event_id
+        or closes; True when progress happened, False on timeout."""
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        with self._cond:
+            def ready() -> bool:
+                latest = self._latest.get(key)
+                return latest is not None and (
+                    latest[0] >= min_next_event_id or latest[1])
+            return self._cond.wait_for(ready, timeout=deadline)
+
+    def forget(self, key: Tuple[str, str, str]) -> None:
+        """Drop a closed execution's entry (retention/scavenger hook)."""
+        with self._cond:
+            self._latest.pop(key, None)

@@ -76,6 +76,8 @@ class Onebox:
         # within the box keeps waiters reachable)
         from .query import QueryRegistry
         self.query_registry = QueryRegistry()
+        from .notifier import HistoryNotifier
+        self.notifier = HistoryNotifier()
 
     def _make_engine(self, shard) -> HistoryEngine:
         engine = HistoryEngine(shard, self.stores, self.clock)
@@ -84,6 +86,7 @@ class Onebox:
         engine.queries = self.query_registry
         engine.metrics = self.metrics
         engine.config = self.config
+        engine.notifier = self.notifier
         return engine
 
     def set_replication_publisher(self, publisher) -> None:

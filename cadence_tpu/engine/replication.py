@@ -170,8 +170,11 @@ class HistoryReplicator:
     - contiguity per branch: dedup below the branch head,
       RetryReplicationError gaps for the resender."""
 
-    def __init__(self, stores: Stores, rebuilder=None) -> None:
+    def __init__(self, stores: Stores, rebuilder=None, notifier=None) -> None:
         self.stores = stores
+        #: wakes the target cluster's history long-polls on replicated
+        #: progress (events/notifier.go on the standby side)
+        self.notifier = notifier
         # conflict-resolution rebuilds run on the accelerator with oracle
         # fallback (engine/rebuild.py DeviceRebuilder; state_rebuilder.go
         # bulk analog); pass the owning cluster's rebuilder so its stats
@@ -398,7 +401,14 @@ class HistoryReplicator:
             ms = rebuilt
         self.stores.execution.upsert_workflow(
             ms, set_current=self._wins_current(key, ms))
+        self._notify(key, ms)
         return True
+
+    def _notify(self, key, ms: MutableState) -> None:
+        from ..core.enums import WorkflowState
+        if self.notifier is not None:
+            self.notifier.notify(key, ms.execution_info.next_event_id,
+                                 ms.execution_info.state == WorkflowState.Completed)
 
     def _forked_batches(self, key, source_branch: int, fork_event_id: int):
         """The fork's prefix batches (source branch up to the fork event),
@@ -440,6 +450,7 @@ class HistoryReplicator:
         ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
         self.stores.execution.upsert_workflow(
             ms, set_current=self._wins_current(key, ms))
+        self._notify(key, ms)
 
     def _wins_current(self, key, ms: MutableState) -> bool:
         """Run-level arbitration (transaction_manager.go create-as-current

@@ -1,0 +1,122 @@
+"""Long-poll: history notifier + parked task polls (VERDICT missing #7).
+
+Reference: events/notifier.go (NotifyNewHistoryEvent pub/sub behind
+GetWorkflowExecutionHistory's long poll, workflowHandler.go:2106) and the
+long-poll transport over matching's sync-match parking.
+"""
+import threading
+
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType, EventType
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "lp-domain"
+TL = "lp-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+class TestHistoryLongPoll:
+    def test_blocks_until_new_event(self, box):
+        """A history long-poll parked past the known tail returns as soon
+        as the next transaction commits."""
+        box.frontend.start_workflow_execution(DOMAIN, "h-1", "signal", TL)
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "h-1")
+        tail = events[-1].id
+
+        result = {}
+
+        def waiter():
+            result["events"] = box.frontend.get_workflow_execution_history(
+                DOMAIN, "h-1", wait_for_new_event=True, last_event_id=tail,
+                timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        # let the waiter park, then produce an event
+        import time
+        time.sleep(0.05)
+        box.frontend.signal_workflow_execution(DOMAIN, "h-1", "wake")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert result["events"][-1].id > tail
+        assert result["events"][-1].event_type in (
+            EventType.WorkflowExecutionSignaled, EventType.DecisionTaskScheduled)
+
+    def test_close_wakes_waiters(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "h-2", "t", TL)
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "h-2")
+        tail = events[-1].id
+        result = {}
+
+        def waiter():
+            result["events"] = box.frontend.get_workflow_execution_history(
+                DOMAIN, "h-2", wait_for_new_event=True, last_event_id=tail,
+                timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        box.frontend.terminate_workflow_execution(DOMAIN, "h-2")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        kinds = [e.event_type for e in result["events"]]
+        assert EventType.WorkflowExecutionTerminated in kinds
+
+    def test_timeout_returns_unchanged_history(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "h-3", "t", TL)
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "h-3")
+        tail = events[-1].id
+        got = box.frontend.get_workflow_execution_history(
+            DOMAIN, "h-3", wait_for_new_event=True, last_event_id=tail,
+            timeout=0.05)
+        assert got[-1].id == tail  # timed out without progress
+
+
+class TestTaskLongPoll:
+    def test_decision_long_poll_sync_matches(self, box):
+        """A long-poll on an empty list parks; a workflow start's decision
+        task sync-matches into it without touching the backlog."""
+        result = {}
+
+        def poller():
+            result["resp"] = box.frontend.poll_for_decision_task(
+                DOMAIN, TL, wait_seconds=5.0)
+
+        t = threading.Thread(target=poller)
+        t.start()
+        import time
+        time.sleep(0.05)
+        box.frontend.start_workflow_execution(DOMAIN, "lp-1", "t", TL)
+        box.pump_once()  # transfer task → matching → sync-match the park
+        t.join(timeout=5)
+        assert not t.is_alive()
+        resp = result["resp"]
+        assert resp is not None and resp.token.workflow_id == "lp-1"
+        # complete it end to end
+        box.frontend.respond_decision_task_completed(
+            resp.token, [Decision(DecisionType.CompleteWorkflowExecution, {})])
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run = box.stores.execution.get_current_run_id(domain_id, "lp-1")
+        ms = box.stores.execution.get_workflow(domain_id, "lp-1", run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_long_poll_times_out_clean(self, box):
+        resp = box.frontend.poll_for_decision_task(DOMAIN, TL,
+                                                   wait_seconds=0.05)
+        assert resp is None
+        # the canceled park must not swallow the next task
+        box.frontend.start_workflow_execution(DOMAIN, "lp-2", "t", TL)
+        box.pump_once()
+        resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        assert resp is not None and resp.token.workflow_id == "lp-2"
