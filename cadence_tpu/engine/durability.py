@@ -22,7 +22,7 @@ Deliberate deviations (documented, test-asserted):
 
 Log record types ("t"): "d" domain, "s" shard info, "h" history batch,
 "f" branch fork, "cb" current-branch pointer, "cur" current-run pointer,
-"q" queue item.
+"q" queue item, "delw" retention tombstone (run deleted).
 """
 from __future__ import annotations
 
@@ -117,6 +117,10 @@ def current_branch_record(domain_id: str, workflow_id: str, run_id: str,
                           branch: int) -> dict:
     return {"t": "cb", "d": domain_id, "w": workflow_id, "r": run_id,
             "b": branch}
+
+
+def delete_run_record(domain_id: str, workflow_id: str, run_id: str) -> dict:
+    return {"t": "delw", "d": domain_id, "w": workflow_id, "r": run_id}
 
 
 def domain_record(info: DomainInfo) -> dict:
@@ -244,6 +248,10 @@ def recover_stores(path: str, verify_on_device: bool = True,
         elif t == "cb":
             stores.history.set_current_branch(rec["d"], rec["w"], rec["r"],
                                               rec["b"])
+        elif t == "delw":
+            # retention tombstone: the run's history and snapshot stay dead
+            stores.history.delete_run(rec["d"], rec["w"], rec["r"])
+            stores.execution.delete_workflow(rec["d"], rec["w"], rec["r"])
         elif t == "cur":
             stores.execution.restore_current(
                 rec["d"], rec["w"],

@@ -1255,6 +1255,35 @@ class HistoryEngine:
         txn.commit(expected)
 
     # ------------------------------------------------------------------
+    # Retention deletion (timer DeleteHistoryEvent →
+    # timerQueueProcessor deleteWorkflow; backstop: the history scavenger,
+    # service/worker/scanner — engine/workers.py)
+    # ------------------------------------------------------------------
+
+    def delete_workflow_execution(self, domain_id: str, workflow_id: str,
+                                  run_id: str) -> bool:
+        """Delete a CLOSED run's history, snapshot, visibility record, and
+        in-memory registrations once its retention elapsed. Never touches
+        an open run. Returns True when anything was deleted."""
+        try:
+            ms = self.stores.execution.get_workflow(domain_id, workflow_id,
+                                                    run_id)
+        except EntityNotExistsError:
+            ms = None
+        if ms is not None and ms.execution_info.state != WorkflowState.Completed:
+            return False  # open run: retention never deletes live state
+        key = (domain_id, workflow_id, run_id)
+        deleted = self.stores.history.delete_run(*key)
+        deleted = self.stores.execution.delete_workflow(*key) or deleted
+        self.stores.visibility.delete_record(*key)
+        self.notifier.forget(key)
+        self.queries.drop_key(key)
+        if deleted:
+            from ..utils import metrics as m
+            self.metrics.inc(m.SCOPE_WORKER_RETENTION, m.M_RUNS_DELETED)
+        return deleted
+
+    # ------------------------------------------------------------------
     # Task refresh (mutable_state_task_refresher.go:77 RefreshTasks)
     # ------------------------------------------------------------------
 

@@ -129,6 +129,14 @@ class _TaskListManager:
         between a missed poll and the park and sleeps the full long-poll
         timeout. Lock order is always child → root, never the reverse."""
         with self._lock:
+            if self._query_buffer:
+                # a buffered query is deliverable work too (queries have no
+                # redispatch timer, so a park must not sleep past one)
+                q = self._query_buffer.popleft()
+                poll._try_deliver(MatchedTask(
+                    domain_id=q[0], workflow_id=q[1], run_id=q[2],
+                    schedule_id=-1, task_list=base, query_id=q[3]))
+                return
             task = self._pop_locked()
             if task is None and fallback is not None:
                 task = fallback.poll()
@@ -185,6 +193,12 @@ class _TaskListManager:
     def poll(self) -> Optional[PersistedTask]:
         with self._lock:
             return self._pop_locked()
+
+    def requeue_front(self, task: PersistedTask) -> None:
+        """Return a polled-but-undeliverable task to the head of the
+        backlog (the sibling-sweep race loser)."""
+        with self._lock:
+            self._buffer.appendleft(task)
 
     def add_query(self, domain_id: str, workflow_id: str, run_id: str,
                   query_id: str) -> None:
@@ -320,6 +334,31 @@ class MatchingEngine:
         root = (self._manager(domain_id, task_list, task_type)
                 if partition != 0 else None)
         mgr.park_or_take(poll, task_list, fallback=root)
+        if poll.task is None and self._num_partitions(task_list) > 1:
+            # close the sibling-partition window: a task persisted to a
+            # sibling BEFORE this park registered would otherwise sleep the
+            # full long-poll timeout (adds after the park sync-match via the
+            # root forward). Sweep existing siblings; if the poll matched
+            # something else meanwhile, put the swept task back.
+            prefix = f"{PARTITION_PREFIX}{task_list}/"
+            with self._lock:
+                siblings = [m for (d, name, t), m in self._managers.items()
+                            if d == domain_id and t == task_type
+                            and (name == task_list or name.startswith(prefix))
+                            and m is not mgr]
+            for sib in siblings:
+                task = sib.poll()
+                if task is None:
+                    continue
+                delivered = poll._try_deliver(MatchedTask(
+                    domain_id=task.domain_id, workflow_id=task.workflow_id,
+                    run_id=task.run_id, schedule_id=task.schedule_id,
+                    task_list=task_list))
+                if delivered and poll._unpark is not None:
+                    poll._unpark()
+                else:
+                    sib.requeue_front(task)
+                break
         return poll
 
     def park_for_decision_task(self, domain_id: str, task_list: str,

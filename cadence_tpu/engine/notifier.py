@@ -24,9 +24,14 @@ class HistoryNotifier:
         """NotifyNewHistoryEvent (historyEngine commit hook)."""
         with self._cond:
             cur = self._latest.get(key)
-            if cur is None or next_event_id >= cur[0]:
-                self._latest[key] = (next_event_id, closed or
-                                     (cur[1] if cur else False))
+            if cur is None:
+                self._latest[key] = (next_event_id, closed)
+            else:
+                # merge: the event-id high-water mark AND the closed bit —
+                # an NDC rewind to a shorter closed branch must still wake
+                # close-waiters even though its next_event_id is lower
+                self._latest[key] = (max(cur[0], next_event_id),
+                                     cur[1] or closed)
             self._cond.notify_all()
 
     def wait_for(self, key: Tuple[str, str, str], min_next_event_id: int,

@@ -78,6 +78,12 @@ class Onebox:
         self.query_registry = QueryRegistry()
         from .notifier import HistoryNotifier
         self.notifier = HistoryNotifier()
+        # system workers (service/worker analogs); a host loop or test
+        # drives run_once() passes
+        from .workers import ExecutionScanner, RetentionScavenger
+        self.scavenger = RetentionScavenger(self.stores, self.route,
+                                            self.clock, self.metrics)
+        self.scanner = ExecutionScanner(self.stores, self.tpu, self.metrics)
 
     def _make_engine(self, shard) -> HistoryEngine:
         engine = HistoryEngine(shard, self.stores, self.clock)

@@ -189,6 +189,20 @@ class HistoryStore:
         with self._lock:
             return self._current.get((domain_id, workflow_id, run_id), 0)
 
+    def delete_run(self, domain_id: str, workflow_id: str, run_id: str) -> bool:
+        """Retention deletion (DeleteHistoryBranch analog): drop every
+        branch of a run; tombstoned in the WAL so recovery doesn't
+        resurrect it."""
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            existed = self._branches.pop(key, None) is not None
+            self._current.pop(key, None)
+            if existed and self._wal is not None:
+                from .durability import delete_run_record
+                self._wal.append(delete_run_record(domain_id, workflow_id,
+                                                   run_id))
+            return existed
+
     def list_runs(self) -> List[Tuple[str, str, str]]:
         with self._lock:
             return list(self._branches.keys())
@@ -358,6 +372,21 @@ class ExecutionStore:
             if cur is None:
                 raise EntityNotExistsError(f"no current execution {workflow_id}")
             return cur.run_id
+
+    def delete_workflow(self, domain_id: str, workflow_id: str,
+                        run_id: str) -> bool:
+        """Drop a run's snapshot; the current pointer is released only if
+        it points at this run and the run is closed (a live current run is
+        never deleted by retention)."""
+        from ..core.enums import WorkflowState
+        with self._lock:
+            existed = self._executions.pop(
+                (domain_id, workflow_id, run_id), None) is not None
+            cur = self._current.get((domain_id, workflow_id))
+            if (cur is not None and cur.run_id == run_id
+                    and cur.state == WorkflowState.Completed):
+                self._current.pop((domain_id, workflow_id), None)
+            return existed
 
     def list_executions(self) -> List[Tuple[str, str, str]]:
         with self._lock:
@@ -537,6 +566,15 @@ class VisibilityStore:
         with self._lock:
             return [r for r in self._records.values()
                     if r.domain_id == domain_id and r.close_status != -1]
+
+    def all_closed(self) -> List[VisibilityRecord]:
+        with self._lock:
+            return [r for r in self._records.values() if r.close_status != -1]
+
+    def delete_record(self, domain_id: str, workflow_id: str,
+                      run_id: str) -> None:
+        with self._lock:
+            self._records.pop((domain_id, workflow_id, run_id), None)
 
 
 # ---------------------------------------------------------------------------

@@ -157,6 +157,46 @@ class QueueProcessors:
                         info.parent_run_id, info.initiated_id, close_event)
                 except EntityNotExistsError:
                     self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
+        self._apply_parent_close_policy(ms)
+
+    def _apply_parent_close_policy(self, parent_ms) -> None:
+        """Children of a closed parent stop per their policy
+        (service/worker/parentclosepolicy/processor.go — the reference fans
+        out through a system workflow for large child counts; the in-line
+        fan-out here is the same semantic for in-process scale). Children
+        still in pending_child_execution_info_ids are the ones that have
+        not closed yet."""
+        from ..core.enums import ParentClosePolicy
+        info = parent_ms.execution_info
+        for ci in list(parent_ms.pending_child_execution_info_ids.values()):
+            policy = ParentClosePolicy(ci.parent_close_policy)
+            if policy == ParentClosePolicy.Abandon or not ci.started_workflow_id:
+                continue
+            child_domain = ci.domain_id or info.domain_id
+            # a child that continued-as-new moved past its pinned first
+            # run: the policy applies to the CURRENT run of the chain
+            run_id = ci.started_run_id or None
+            if run_id is not None:
+                try:
+                    pinned = self.stores.execution.get_workflow(
+                        child_domain, ci.started_workflow_id, run_id)
+                    if (pinned.execution_info.close_status
+                            == CloseStatus.ContinuedAsNew):
+                        run_id = None
+                except EntityNotExistsError:
+                    run_id = None
+            try:
+                child_engine = self.router(ci.started_workflow_id)
+                if policy == ParentClosePolicy.Terminate:
+                    child_engine.terminate_workflow(
+                        child_domain, ci.started_workflow_id, run_id,
+                        reason="parent-close-policy")
+                elif policy == ParentClosePolicy.RequestCancel:
+                    child_engine.request_cancel_workflow(
+                        child_domain, ci.started_workflow_id, run_id)
+            except (EntityNotExistsError, InvalidRequestError):
+                # child already closed / cancel already requested
+                self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
 
     def _start_child(self, engine: "HistoryEngine", domain_id: str,
                      workflow_id: str, run_id: str, task: GeneratedTask) -> None:
@@ -288,7 +328,11 @@ class QueueProcessors:
             elif tt == TimerTaskType.WorkflowBackoffTimer:
                 engine.schedule_first_decision(domain_id, workflow_id, run_id)
             elif tt == TimerTaskType.DeleteHistoryEvent:
-                pass  # retention deletion handled by the scavenger worker
+                # retention elapsed: delete the closed run
+                # (timer_task_executor deleteWorkflow; the scavenger in
+                # engine/workers.py is the backstop for lost timers)
+                engine.delete_workflow_execution(domain_id, workflow_id,
+                                                 run_id)
             elif tt == TimerTaskType.ActivityRetryTimer:
                 self._dispatch_activity_retry(domain_id, workflow_id, run_id,
                                               task)

@@ -1,0 +1,126 @@
+"""System workers: retention scavenger + execution scanner.
+
+Reference: service/worker/ — background system workflows running against
+the cluster itself. Implemented here as explicit passes a host loop (or a
+test) drives:
+
+- **RetentionScavenger** (service/worker/scanner history scavenger): the
+  backstop for lost DeleteHistoryEvent timers — sweeps closed runs whose
+  retention elapsed (by visibility close time + domain retention) and
+  deletes them through the owning engine;
+- **ExecutionScanner** (service/worker/scanner executions scanner over
+  common/reconciliation/invariant): checks concrete-execution invariants —
+  every current pointer resolves to a persisted run, every persisted run
+  has history — and runs the device bulk verify (verify_all) as the
+  mutable-state invariant; `fix=True` drops orphaned current pointers
+  (the concreteExecutionExists fixer).
+
+Parent-close-policy fan-out lives on the close path itself
+(queues._apply_parent_close_policy — the reference routes it through a
+parentclosepolicy system workflow for scale; same semantic).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from .persistence import EntityNotExistsError, Stores
+
+_DAY_NANOS = 24 * 3600 * 1_000_000_000
+
+
+class RetentionScavenger:
+    """Sweep closed runs past retention (scanner/history scavenger)."""
+
+    def __init__(self, stores: Stores, router, time_source, metrics=None) -> None:
+        from ..utils.metrics import DEFAULT_REGISTRY
+        self.stores = stores
+        self.router = router
+        self.clock = time_source
+        self.metrics = metrics if metrics is not None else DEFAULT_REGISTRY
+
+    def run_once(self) -> int:
+        """Delete every closed run whose close time + domain retention is
+        past; returns how many runs were deleted."""
+        now = self.clock.now()
+        deleted = 0
+        for rec in self.stores.visibility.all_closed():
+            try:
+                retention_days = self.stores.domain.by_id(
+                    rec.domain_id).retention_days
+            except EntityNotExistsError:
+                retention_days = 1
+            if rec.close_time + retention_days * _DAY_NANOS > now:
+                continue
+            engine = self.router(rec.workflow_id)
+            if engine.delete_workflow_execution(rec.domain_id,
+                                                rec.workflow_id, rec.run_id):
+                deleted += 1
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_WORKER_SCAVENGER, m.M_RUNS_DELETED, deleted)
+        return deleted
+
+
+@dataclass
+class ScanReport:
+    """common/reconciliation invariant results."""
+
+    executions: int = 0
+    orphan_pointers: List[Tuple[str, str, str]] = field(default_factory=list)
+    missing_history: List[Tuple[str, str, str]] = field(default_factory=list)
+    state_divergent: List[Tuple[str, str, str]] = field(default_factory=list)
+    fixed: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not (self.orphan_pointers or self.missing_history
+                    or self.state_divergent)
+
+
+class ExecutionScanner:
+    """Concrete-execution invariants + device bulk verify."""
+
+    def __init__(self, stores: Stores, tpu, metrics=None) -> None:
+        from ..utils.metrics import DEFAULT_REGISTRY
+        self.stores = stores
+        self.tpu = tpu
+        self.metrics = metrics if metrics is not None else DEFAULT_REGISTRY
+
+    def run_once(self, fix: bool = False) -> ScanReport:
+        report = ScanReport()
+        # invariant: current pointer → persisted run
+        # (invariant/openCurrentExecution.go / concreteExecutionExists.go)
+        for (domain_id, workflow_id), cur in \
+                self.stores.execution.list_current_pointers():
+            try:
+                self.stores.execution.get_workflow(domain_id, workflow_id,
+                                                   cur.run_id)
+            except EntityNotExistsError:
+                report.orphan_pointers.append(
+                    (domain_id, workflow_id, cur.run_id))
+                if fix:
+                    self.stores.execution.drop_current(domain_id, workflow_id)
+                    report.fixed += 1
+        # invariant: every persisted run has history
+        # (invariant/historyExists.go)
+        keys = self.stores.execution.list_executions()
+        report.executions = len(keys)
+        with_history = []
+        for key in keys:
+            if self.stores.history.branch_count(*key) == 0:
+                report.missing_history.append(key)
+            else:
+                with_history.append(key)
+        # invariant: mutable state replays bit-exact on device (the
+        # checksum oracle as a scanner invariant, execution/checksum.go)
+        if with_history:
+            result = self.tpu.verify_all(with_history)
+            report.state_divergent = list(result.divergent)
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_WORKER_SCANNER, m.M_EXECUTIONS_SCANNED,
+                         report.executions)
+        self.metrics.inc(m.SCOPE_WORKER_SCANNER, m.M_INVARIANT_VIOLATIONS,
+                         len(report.orphan_pointers)
+                         + len(report.missing_history)
+                         + len(report.state_divergent))
+        return report

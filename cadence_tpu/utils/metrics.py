@@ -32,6 +32,9 @@ SCOPE_QUEUE_TIMER = "queue.timer"
 SCOPE_REPLICATION = "replication.task-processor"
 SCOPE_TPU_REPLAY = "tpu.replay-engine"
 SCOPE_REBUILD = "tpu.device-rebuilder"
+SCOPE_WORKER_RETENTION = "worker.retention"
+SCOPE_WORKER_SCAVENGER = "worker.scavenger"
+SCOPE_WORKER_SCANNER = "worker.scanner"
 
 # -- metric names -----------------------------------------------------------
 
@@ -52,6 +55,9 @@ M_ORACLE_FALLBACKS = "oracle-fallbacks"
 M_FALLBACK_RATE = "fallback-rate"
 M_BUFFERED_FLUSHED = "buffered-events-flushed"
 M_RATE_LIMITED = "requests-rate-limited"
+M_RUNS_DELETED = "runs-deleted"
+M_EXECUTIONS_SCANNED = "executions-scanned"
+M_INVARIANT_VIOLATIONS = "invariant-violations"
 
 
 @dataclass

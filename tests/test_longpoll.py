@@ -11,8 +11,6 @@ import pytest
 from cadence_tpu.core.enums import CloseStatus, DecisionType, EventType
 from cadence_tpu.engine.history_engine import Decision
 from cadence_tpu.engine.onebox import Onebox
-from cadence_tpu.models.deciders import CompleteDecider
-from tests.taskpoller import TaskPoller
 
 DOMAIN = "lp-domain"
 TL = "lp-tl"

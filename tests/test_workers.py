@@ -1,0 +1,169 @@
+"""System workers: retention deletion + parent close policy + scanner
+(VERDICT ask #8, missing #5).
+
+Reference: service/worker/scanner (history scavenger, executions
+scanner/fixer), service/worker/parentclosepolicy/processor.go, and the
+DeleteHistoryEvent timer arm of the timer queue executor.
+"""
+import pytest
+
+from cadence_tpu.core.enums import (
+    CloseStatus,
+    DecisionType,
+    ParentClosePolicy,
+    WorkflowState,
+)
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.engine.persistence import EntityNotExistsError
+from cadence_tpu.models.deciders import CompleteDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "worker-domain"
+TL = "worker-tl"
+DAY = 86_400
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _run_to_completion(box, wf):
+    box.frontend.start_workflow_execution(DOMAIN, wf, "t", TL)
+    TaskPoller(box, DOMAIN, TL, {wf: CompleteDecider()}).drain()
+    domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+    run_id = box.stores.execution.get_current_run_id(domain_id, wf)
+    return domain_id, run_id
+
+
+class TestRetention:
+    def test_delete_timer_removes_closed_run(self, box):
+        domain_id, run_id = _run_to_completion(box, "ret-1")
+        assert box.stores.history.branch_count(domain_id, "ret-1", run_id) == 1
+
+        box.advance_time(DAY + 60)  # default domain retention: 1 day
+        box.pump_once()             # DeleteHistoryEvent timer fires
+
+        assert box.stores.history.branch_count(domain_id, "ret-1", run_id) == 0
+        with pytest.raises(EntityNotExistsError):
+            box.stores.execution.get_workflow(domain_id, "ret-1", run_id)
+        # visibility gone, workflow id startable again
+        assert all(r.run_id != run_id
+                   for r in box.stores.visibility.list_closed(DOMAIN))
+        box.frontend.start_workflow_execution(DOMAIN, "ret-1", "t", TL)
+
+    def test_retention_never_deletes_open_run(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "ret-2", "signal", TL)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "ret-2")
+        engine = box.route("ret-2")
+        assert not engine.delete_workflow_execution(domain_id, "ret-2", run_id)
+        assert box.stores.history.branch_count(domain_id, "ret-2", run_id) == 1
+
+    def test_tombstone_survives_recovery(self, tmp_path):
+        """A deleted run must NOT be resurrected by WAL replay."""
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            recover_stores,
+        )
+
+        path = str(tmp_path / "wal.log")
+        box = Onebox(num_hosts=1, num_shards=4,
+                     stores=open_durable_stores(path))
+        box.frontend.register_domain(DOMAIN)
+        domain_id, run_id = _run_to_completion(box, "ret-3")
+        box.advance_time(DAY + 60)
+        box.pump_once()
+        assert box.stores.history.branch_count(domain_id, "ret-3", run_id) == 0
+
+        stores, report = recover_stores(path)
+        assert (domain_id, "ret-3", run_id) not in stores.history.list_runs()
+        assert report.ok
+
+    def test_scavenger_backstop_sweeps_lost_timer(self, box):
+        """The scavenger deletes expired runs even when the deletion timer
+        was lost (crash between close and timer fire)."""
+        domain_id, run_id = _run_to_completion(box, "ret-4")
+        box.advance_time(DAY + 60)
+        # DON'T pump (simulates the lost timer): sweep directly
+        deleted = box.scavenger.run_once()
+        assert deleted == 1
+        assert box.stores.history.branch_count(domain_id, "ret-4", run_id) == 0
+
+    def test_scavenger_respects_retention_window(self, box):
+        domain_id, run_id = _run_to_completion(box, "ret-5")
+        box.advance_time(3600)  # one hour < 1 day retention
+        assert box.scavenger.run_once() == 0
+        assert box.stores.history.branch_count(domain_id, "ret-5", run_id) == 1
+
+
+def _start_parent_with_child(box, wf, policy: ParentClosePolicy):
+    box.frontend.start_workflow_execution(DOMAIN, wf, "parent", TL)
+    box.pump_once()
+    resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+    box.frontend.respond_decision_task_completed(
+        resp.token, [Decision(DecisionType.StartChildWorkflowExecution,
+                              dict(workflow_id=f"{wf}-child",
+                                   workflow_type="child",
+                                   task_list=TL,
+                                   parent_close_policy=int(policy)))])
+    box.pump_once()  # start the child, deliver ChildWorkflowExecutionStarted
+    domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+    child_run = box.stores.execution.get_current_run_id(domain_id, f"{wf}-child")
+    return domain_id, child_run
+
+
+class TestParentClosePolicy:
+    def test_terminate_policy_stops_child(self, box):
+        domain_id, child_run = _start_parent_with_child(
+            box, "pcp-t", ParentClosePolicy.Terminate)
+        box.frontend.terminate_workflow_execution(DOMAIN, "pcp-t")
+        box.pump_once()  # close fan-out
+        child = box.stores.execution.get_workflow(domain_id, "pcp-t-child",
+                                                  child_run)
+        assert child.execution_info.close_status == CloseStatus.Terminated
+
+    def test_cancel_policy_requests_cancel(self, box):
+        domain_id, child_run = _start_parent_with_child(
+            box, "pcp-c", ParentClosePolicy.RequestCancel)
+        box.frontend.terminate_workflow_execution(DOMAIN, "pcp-c")
+        box.pump_once()
+        child = box.stores.execution.get_workflow(domain_id, "pcp-c-child",
+                                                  child_run)
+        assert child.execution_info.cancel_requested
+        assert child.execution_info.state == WorkflowState.Running
+
+    def test_abandon_policy_leaves_child_running(self, box):
+        domain_id, child_run = _start_parent_with_child(
+            box, "pcp-a", ParentClosePolicy.Abandon)
+        box.frontend.terminate_workflow_execution(DOMAIN, "pcp-a")
+        box.pump_once()
+        child = box.stores.execution.get_workflow(domain_id, "pcp-a-child",
+                                                  child_run)
+        assert child.execution_info.state == WorkflowState.Running
+        assert not child.execution_info.cancel_requested
+
+
+class TestScanner:
+    def test_healthy_cluster_scans_clean(self, box):
+        _run_to_completion(box, "scan-1")
+        report = box.scanner.run_once()
+        assert report.ok
+        assert report.executions >= 1
+
+    def test_orphan_pointer_detected_and_fixed(self, box):
+        from cadence_tpu.engine.persistence import CurrentExecution
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        box.stores.execution.restore_current(
+            domain_id, "ghost", CurrentExecution(run_id="no-such-run",
+                                                 state=WorkflowState.Running,
+                                                 close_status=0))
+        report = box.scanner.run_once(fix=True)
+        assert (domain_id, "ghost", "no-such-run") in report.orphan_pointers
+        assert report.fixed == 1
+        # fixed: pointer dropped, id startable
+        report2 = box.scanner.run_once()
+        assert report2.ok
