@@ -1,0 +1,209 @@
+"""Operator CLI (tools/cli/app.go analog).
+
+The reference's `cadence` CLI talks gRPC to a running cluster; this
+framework's cluster state is a durable WAL directory, so the CLI opens
+the WAL (recovering state exactly like a restarted host), runs the
+command against an in-process cluster, and appends any mutations back to
+the same WAL — the same durability story a server would have.
+
+    python -m cadence_tpu --wal ./cluster.wal domain register --name dev
+    python -m cadence_tpu --wal ./cluster.wal workflow start \
+        --domain dev --workflow-id wf-1 --type t --task-list tl
+    python -m cadence_tpu --wal ./cluster.wal workflow show \
+        --domain dev --workflow-id wf-1
+    python -m cadence_tpu --wal ./cluster.wal admin verify
+
+Output is JSON per command for scriptability (the reference CLI's
+--format json mode).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+
+def _ensure_jax_backend() -> None:
+    """Operator machines may carry a JAX_PLATFORMS pointing at a plugin
+    that isn't loadable here; probe in a subprocess (jax caches backend
+    init failures in-process) and fall back to CPU so the CLI always
+    works."""
+    import subprocess
+    if not os.environ.get("JAX_PLATFORMS"):
+        return
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        capture_output=True)
+    if probe.returncode != 0:
+        print(f"warning: JAX backend '{os.environ['JAX_PLATFORMS']}' "
+              "unavailable; falling back to cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _build_cluster(wal: str):
+    from .engine.durability import open_durable_stores, recover_stores
+    from .engine.onebox import Onebox
+    from .utils.clock import RealTimeSource
+
+    if os.path.exists(wal):
+        # commands verify explicitly (admin verify/scan); recovery itself
+        # skips the device pass so cheap reads stay cheap
+        stores, report = recover_stores(wal, verify_on_device=False)
+    else:
+        stores, report = open_durable_stores(wal), None
+    # the wall clock, not the test clock: retention, cron, and timeouts
+    # must actually elapse in CLI-driven clusters
+    box = Onebox(num_hosts=1, num_shards=4, stores=stores,
+                 time_source=RealTimeSource())
+    if report is not None and report.open_workflows:
+        box.refresh_all_tasks()
+    return box, report
+
+
+def _emit(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, sort_keys=True, default=str))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cadence-tpu", description="cadence_tpu operator CLI")
+    parser.add_argument("--wal", required=True,
+                        help="cluster WAL path (durable state)")
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    # domain
+    dom = sub.add_parser("domain").add_subparsers(dest="cmd", required=True)
+    reg = dom.add_parser("register")
+    reg.add_argument("--name", required=True)
+    reg.add_argument("--retention", type=int, default=0)
+    dom.add_parser("list")
+
+    # workflow
+    wf = sub.add_parser("workflow").add_subparsers(dest="cmd", required=True)
+    start = wf.add_parser("start")
+    start.add_argument("--domain", required=True)
+    start.add_argument("--workflow-id", required=True)
+    start.add_argument("--type", required=True)
+    start.add_argument("--task-list", required=True)
+    start.add_argument("--cron", default="")
+    for name in ("show", "describe"):
+        p = wf.add_parser(name)
+        p.add_argument("--domain", required=True)
+        p.add_argument("--workflow-id", required=True)
+        p.add_argument("--run-id", default=None)
+    sig = wf.add_parser("signal")
+    sig.add_argument("--domain", required=True)
+    sig.add_argument("--workflow-id", required=True)
+    sig.add_argument("--name", required=True)
+    term = wf.add_parser("terminate")
+    term.add_argument("--domain", required=True)
+    term.add_argument("--workflow-id", required=True)
+    term.add_argument("--reason", default="cli")
+    lst = wf.add_parser("list")
+    lst.add_argument("--domain", required=True)
+    lst.add_argument("--closed", action="store_true")
+
+    # admin
+    adm = sub.add_parser("admin").add_subparsers(dest="cmd", required=True)
+    adm.add_parser("describe-cluster")
+    dq = adm.add_parser("describe-queue")
+    dq.add_argument("--shard-id", type=int, required=True)
+    adm.add_parser("verify")
+    scan = adm.add_parser("scan")
+    scan.add_argument("--fix", action="store_true")
+    adm.add_parser("scavenge")
+    cg = adm.add_parser("config-get")
+    cg.add_argument("--key", required=True)
+    cs = adm.add_parser("config-set")
+    cs.add_argument("--key", required=True)
+    cs.add_argument("--value", required=True)
+
+    args = parser.parse_args(argv)
+    _ensure_jax_backend()
+    box, _report = _build_cluster(args.wal)
+    from .engine.admin import AdminHandler
+    admin = AdminHandler(box)
+
+    if args.group == "domain":
+        if args.cmd == "register":
+            domain_id = box.frontend.register_domain(
+                args.name, retention_days=args.retention)
+            _emit({"registered": args.name, "domain_id": domain_id})
+        elif args.cmd == "list":
+            _emit([{"name": d.name, "domain_id": d.domain_id,
+                    "retention_days": d.retention_days}
+                   for d in box.frontend.list_domains()])
+
+    elif args.group == "workflow":
+        if args.cmd == "start":
+            run_id = box.frontend.start_workflow_execution(
+                args.domain, args.workflow_id, args.type, args.task_list,
+                cron_schedule=args.cron)
+            box.pump_once()
+            _emit({"started": args.workflow_id, "run_id": run_id})
+        elif args.cmd == "show":
+            events = box.frontend.get_workflow_execution_history(
+                args.domain, args.workflow_id, args.run_id)
+            _emit([{"id": e.id, "type": e.event_type.name,
+                    "version": e.version, "attrs": e.attrs}
+                   for e in events])
+        elif args.cmd == "describe":
+            _emit(admin.describe_workflow_execution(
+                args.domain, args.workflow_id, args.run_id))
+        elif args.cmd == "signal":
+            box.frontend.signal_workflow_execution(
+                args.domain, args.workflow_id, args.name)
+            box.pump_once()
+            _emit({"signaled": args.workflow_id})
+        elif args.cmd == "terminate":
+            box.frontend.terminate_workflow_execution(
+                args.domain, args.workflow_id, reason=args.reason)
+            box.pump_once()
+            _emit({"terminated": args.workflow_id})
+        elif args.cmd == "list":
+            recs = (box.frontend.list_closed_workflow_executions(args.domain)
+                    if args.closed else
+                    box.frontend.list_open_workflow_executions(args.domain))
+            _emit([{"workflow_id": r.workflow_id, "run_id": r.run_id,
+                    "type": r.workflow_type, "close_status": r.close_status}
+                   for r in recs])
+
+    elif args.group == "admin":
+        if args.cmd == "describe-cluster":
+            _emit(admin.describe_cluster())
+        elif args.cmd == "describe-queue":
+            _emit(admin.describe_queue(args.shard_id))
+        elif args.cmd == "verify":
+            result = admin.verify()
+            _emit({"total": result.total,
+                   "verified_on_device": result.verified_on_device,
+                   "fallback": len(result.fallback),
+                   "divergent": result.divergent, "ok": result.ok})
+            return 0 if result.ok else 1
+        elif args.cmd == "scan":
+            report = box.scanner.run_once(fix=args.fix)
+            _emit({"executions": report.executions,
+                   "orphan_pointers": report.orphan_pointers,
+                   "missing_history": report.missing_history,
+                   "state_divergent": report.state_divergent,
+                   "fixed": report.fixed, "ok": report.ok})
+            return 0 if report.ok else 1
+        elif args.cmd == "scavenge":
+            _emit({"deleted": box.scavenger.run_once()})
+        elif args.cmd == "config-get":
+            _emit({args.key: admin.get_dynamic_config(args.key)})
+        elif args.cmd == "config-set":
+            value: Any = args.value
+            try:
+                value = json.loads(args.value)
+            except json.JSONDecodeError:
+                pass
+            admin.update_dynamic_config(args.key, value)
+            _emit({args.key: value})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

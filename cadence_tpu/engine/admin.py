@@ -1,0 +1,136 @@
+"""Admin/ops surface.
+
+Reference: service/frontend/adminHandler.go — DescribeWorkflowExecution
+(raw mutable state + checksum), DescribeHistoryHost, DescribeQueue,
+CloseShard, dynamic-config CRUD — plus DescribeCluster-style rollups the
+CLI consumes (tools/cli admin commands).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core.checksum import Checksum
+from .persistence import EntityNotExistsError
+
+
+class AdminHandler:
+    """Operator API over one cluster (an Onebox or equivalent wiring)."""
+
+    def __init__(self, box) -> None:
+        self.box = box
+
+    # -- execution introspection (adminHandler DescribeWorkflowExecution) --
+
+    def describe_workflow_execution(self, domain: str, workflow_id: str,
+                                    run_id: Optional[str] = None
+                                    ) -> Dict[str, Any]:
+        """Raw mutable state: execution info, pending tables, version
+        histories, buffered events, checksum."""
+        stores = self.box.stores
+        domain_id = stores.domain.by_name(domain).domain_id
+        if run_id is None:
+            run_id = stores.execution.get_current_run_id(domain_id, workflow_id)
+        ms = stores.execution.get_workflow(domain_id, workflow_id, run_id)
+        info = ms.execution_info
+        return {
+            "execution": {"domain_id": domain_id, "workflow_id": workflow_id,
+                          "run_id": run_id},
+            "state": int(info.state),
+            "close_status": int(info.close_status),
+            "next_event_id": info.next_event_id,
+            "last_first_event_id": info.last_first_event_id,
+            "decision": {
+                "schedule_id": info.decision_schedule_id,
+                "started_id": info.decision_started_id,
+                "attempt": info.decision_attempt,
+            },
+            "sticky_task_list": info.sticky_task_list,
+            "pending_activities": sorted(ms.pending_activity_info_ids),
+            "pending_timers": sorted(
+                ti.started_id for ti in ms.pending_timer_info_ids.values()),
+            "pending_children": sorted(ms.pending_child_execution_info_ids),
+            "buffered_events": len(ms.buffered_events),
+            "version_histories": {
+                "current_index": ms.version_histories.current_index,
+                "branches": [
+                    [(i.event_id, i.version) for i in h.items]
+                    for h in ms.version_histories.histories
+                ],
+            },
+            "checksum": f"0x{Checksum.of(ms).value:08x}",
+            "history_length": len(stores.history.read_events(
+                domain_id, workflow_id, run_id)),
+        }
+
+    # -- host / shard introspection (DescribeHistoryHost, handler.go:741) --
+
+    def describe_history_host(self, host: str) -> Dict[str, Any]:
+        controller = self.box.controllers[host]
+        shards = sorted(controller.assigned_shards())
+        return {"host": host, "shard_count": len(shards),
+                "shard_ids": shards,
+                "num_shards_total": self.box.num_shards}
+
+    def describe_cluster(self) -> Dict[str, Any]:
+        return {
+            "cluster": self.box.cluster_name,
+            "hosts": {h: self.describe_history_host(h)["shard_count"]
+                      for h in self.box.hosts},
+            "num_shards": self.box.num_shards,
+            "executions": len(self.box.stores.execution.list_executions()),
+            "matching_backlog": self.box.matching.backlog(),
+            "metrics": self.box.metrics.snapshot(),
+        }
+
+    # -- queue introspection (DescribeQueue, handler.go:851) ---------------
+
+    def describe_queue(self, shard_id: int) -> Dict[str, Any]:
+        for controller in self.box.controllers.values():
+            try:
+                engine = controller.engine_for_shard(shard_id)
+            except Exception:
+                continue
+            shard = engine.shard
+            return {
+                "shard_id": shard_id,
+                "range_id": shard.range_id,
+                "transfer_ack_level": shard.transfer_ack_level,
+                "pending_transfer": len(shard.read_transfer_tasks(
+                    shard.transfer_ack_level)),
+            }
+        raise EntityNotExistsError(f"no live owner for shard {shard_id}")
+
+    def close_shard(self, shard_id: int) -> bool:
+        """CloseShard (adminHandler): force the owning engine's shard
+        closed so the next write fences and ownership re-acquires."""
+        for controller in self.box.controllers.values():
+            try:
+                engine = controller.engine_for_shard(shard_id)
+            except Exception:
+                continue
+            engine.shard.close()
+            return True
+        return False
+
+    # -- dynamic config CRUD (adminHandler config commands) ----------------
+
+    def get_dynamic_config(self, key: str,
+                           domain: Optional[str] = None) -> Any:
+        return self.box.config.get(key, domain=domain)
+
+    def update_dynamic_config(self, key: str, value: Any,
+                              domain: Optional[str] = None) -> None:
+        self.box.config.set(key, value, domain=domain)
+
+    # -- maintenance passthroughs ------------------------------------------
+
+    def refresh_workflow_tasks(self, domain: str, workflow_id: str,
+                               run_id: Optional[str] = None) -> int:
+        domain_id = self.box.stores.domain.by_name(domain).domain_id
+        return self.box.route(workflow_id).refresh_tasks(domain_id,
+                                                         workflow_id, run_id)
+
+    def verify(self, keys: Optional[List] = None):
+        """Device bulk verify (the scanner's state invariant, exposed to
+        operators like the CLI admin db scan)."""
+        return self.box.tpu.verify_all(keys)

@@ -350,6 +350,20 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
         report.executions_rebuilt += 1
         if ms.execution_info.state != WorkflowState.Completed:
             report.open_workflows += 1
+        # visibility is DERIVED data (the reference reindexes ES from
+        # history); rebuild the records here instead of logging them.
+        # Close time approximates to the completion event's timestamp.
+        from .persistence import VisibilityRecord
+        info = ms.execution_info
+        stores.visibility.record_started(VisibilityRecord(
+            domain_id=key[0], workflow_id=key[1], run_id=key[2],
+            workflow_type=info.workflow_type_name,
+            start_time=info.start_timestamp))
+        if info.state == WorkflowState.Completed:
+            events = stores.history.read_events(*key)
+            stores.visibility.record_closed(
+                *key, close_time=events[-1].timestamp if events else 0,
+                close_status=info.close_status)
 
     if verify_on_device and report.executions_rebuilt:
         from .tpu_engine import TPUReplayEngine

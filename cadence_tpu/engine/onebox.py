@@ -28,13 +28,15 @@ class Onebox:
     def __init__(self, num_hosts: int = 2, num_shards: int = 8,
                  cluster_name: str = "primary",
                  stores: Optional[Stores] = None,
-                 config=None) -> None:
+                 config=None, time_source=None) -> None:
         from ..utils.dynamicconfig import DynamicConfig
         from ..utils.metrics import MetricsRegistry
         #: injected stores = durable bundle (crash recovery) or a shared
         #: bundle; default = fresh in-memory cluster
         self.stores = stores if stores is not None else Stores()
-        self.clock = ManualTimeSource()
+        #: tests drive the default manual clock; real deployments (the
+        #: CLI) inject RealTimeSource so timers/retention actually elapse
+        self.clock = time_source if time_source is not None else ManualTimeSource()
         #: runtime knobs (common/dynamicconfig analog) + cluster metrics
         self.config = config if config is not None else DynamicConfig()
         self.metrics = MetricsRegistry()

@@ -92,13 +92,22 @@ class DeviceRebuilder:
         max_events = max(history_length(b) for b, _ in jobs)
         corpus = encode_corpus([b for b, _ in jobs], max_events)
         total_events = sum(history_length(b) for b, _ in jobs)
-        scope.inc(m.M_KERNEL_LAUNCHES)
-        scope.inc(m.M_EVENTS_REPLAYED, total_events)
-        with scope.timed():
-            state, _log = replay_events_with_tasks(jnp.asarray(corpus),
-                                                   self.layout)
-            rows = np.asarray(payload_rows(state, self.layout))
-            arrs = jax.device_get(state)
+        try:
+            with scope.timed():
+                state, _log = replay_events_with_tasks(jnp.asarray(corpus),
+                                                       self.layout)
+                rows = np.asarray(payload_rows(state, self.layout))
+                arrs = jax.device_get(state)
+            scope.inc(m.M_KERNEL_LAUNCHES)
+            scope.inc(m.M_EVENTS_REPLAYED, total_events)
+        except RuntimeError:
+            # no usable accelerator backend (e.g. the CLI on a machine
+            # whose JAX_PLATFORMS points at an unavailable plugin):
+            # recovery must still work — everything goes to the oracle,
+            # counted as fallbacks
+            self.stats.oracle_fallback += len(jobs)
+            scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
+            return [self._oracle_rebuild(b, e) for b, e in jobs]
 
         out: List[MutableState] = []
         for i, (batches, entry) in enumerate(jobs):

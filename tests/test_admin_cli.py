@@ -1,0 +1,122 @@
+"""Admin handler + operator CLI (VERDICT missing #8).
+
+Reference: service/frontend/adminHandler.go + tools/cli/app.go.
+"""
+import json
+
+import pytest
+
+from cadence_tpu.cli import main as cli_main
+from cadence_tpu.engine.admin import AdminHandler
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider, SignalDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "admin-domain"
+TL = "admin-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=2, num_shards=8)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+class TestAdminHandler:
+    def test_describe_workflow_execution_raw_state(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "a-1", "signal", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"a-1": SignalDecider(expected_signals=2)})
+        poller.drain()
+        desc = AdminHandler(box).describe_workflow_execution(DOMAIN, "a-1")
+        assert desc["state"] == 1  # Running
+        assert desc["next_event_id"] >= 5
+        assert desc["checksum"].startswith("0x")
+        assert desc["version_histories"]["current_index"] == 0
+        assert desc["history_length"] == desc["next_event_id"] - 1
+
+    def test_describe_history_host_and_cluster(self, box):
+        admin = AdminHandler(box)
+        total = sum(admin.describe_history_host(h)["shard_count"]
+                    for h in box.hosts)
+        assert total == box.num_shards
+        cluster = admin.describe_cluster()
+        assert cluster["num_shards"] == 8
+        assert set(cluster["hosts"]) == set(box.hosts)
+
+    def test_describe_queue_and_close_shard(self, box):
+        admin = AdminHandler(box)
+        q = admin.describe_queue(0)
+        assert q["shard_id"] == 0 and q["range_id"] >= 1
+        assert admin.close_shard(0)
+
+    def test_dynamic_config_crud(self, box):
+        from cadence_tpu.utils.dynamicconfig import KEY_FRONTEND_RPS
+        admin = AdminHandler(box)
+        assert admin.get_dynamic_config(KEY_FRONTEND_RPS) == 0
+        admin.update_dynamic_config(KEY_FRONTEND_RPS, 50)
+        assert box.config.get(KEY_FRONTEND_RPS) == 50
+
+
+class TestCLI:
+    def _run(self, capsys, *argv):
+        rc = cli_main(list(argv))
+        out = capsys.readouterr().out
+        return rc, json.loads(out)
+
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        wal = str(tmp_path / "cluster.wal")
+        rc, out = self._run(capsys, "--wal", wal, "domain", "register",
+                            "--name", "dev")
+        assert rc == 0 and out["registered"] == "dev"
+
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "start",
+                            "--domain", "dev", "--workflow-id", "wf-1",
+                            "--type", "t", "--task-list", TL)
+        assert rc == 0 and "run_id" in out
+
+        # state survived across CLI invocations (WAL round-trip)
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "show",
+                            "--domain", "dev", "--workflow-id", "wf-1")
+        assert rc == 0
+        assert out[0]["type"] == "WorkflowExecutionStarted"
+
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "describe",
+                            "--domain", "dev", "--workflow-id", "wf-1")
+        assert rc == 0 and out["state"] == 1
+
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "list",
+                            "--domain", "dev")
+        assert rc == 0 and out[0]["workflow_id"] == "wf-1"
+
+        rc, out = self._run(capsys, "--wal", wal, "admin", "verify")
+        assert rc == 0 and out["ok"] is True
+
+        rc, out = self._run(capsys, "--wal", wal, "admin", "scan")
+        assert rc == 0 and out["ok"] is True
+
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "terminate",
+                            "--domain", "dev", "--workflow-id", "wf-1")
+        assert rc == 0
+
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "list",
+                            "--domain", "dev", "--closed")
+        assert rc == 0 and out[0]["workflow_id"] == "wf-1"
+
+    def test_cli_config_roundtrip(self, tmp_path, capsys):
+        wal = str(tmp_path / "cluster.wal")
+        rc, out = self._run(capsys, "--wal", wal, "admin", "config-set",
+                            "--key", "frontend.rps", "--value", "25")
+        assert rc == 0 and out["frontend.rps"] == 25
+        # note: config is per-process (the reference's configstore persists
+        # it; ours lives with the host) — the get below reads the default
+        rc, out = self._run(capsys, "--wal", wal, "admin", "config-get",
+                            "--key", "frontend.rps")
+        assert rc == 0
+
+    def test_cli_describe_cluster(self, tmp_path, capsys):
+        wal = str(tmp_path / "cluster.wal")
+        rc, out = self._run(capsys, "--wal", wal, "admin",
+                            "describe-cluster")
+        assert rc == 0 and out["num_shards"] == 4
