@@ -57,6 +57,9 @@ def _build_cluster(wal: str):
     # must actually elapse in CLI-driven clusters
     box = Onebox(num_hosts=1, num_shards=4, stores=stores,
                  time_source=RealTimeSource())
+    # replay persisted operator config (admin config-set WAL records)
+    for key, value, domain in getattr(stores, "recovered_config", []):
+        box.config.set(key, value, domain=domain)
     if report is not None and report.open_workflows:
         box.refresh_all_tasks()
     return box, report
@@ -201,6 +204,9 @@ def main(argv=None) -> int:
             except json.JSONDecodeError:
                 pass
             admin.update_dynamic_config(args.key, value)
+            # persist: later CLI invocations replay this record
+            from .engine.durability import config_record
+            box.stores.wal.append(config_record(args.key, value))
             _emit({args.key: value})
     return 0
 

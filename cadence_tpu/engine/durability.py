@@ -22,7 +22,8 @@ Deliberate deviations (documented, test-asserted):
 
 Log record types ("t"): "d" domain, "s" shard info, "h" history batch,
 "f" branch fork, "cb" current-branch pointer, "cur" current-run pointer,
-"q" queue item, "delw" retention tombstone (run deleted).
+"q" queue item, "delw" retention tombstone (run deleted), "cfg" dynamic
+config write.
 """
 from __future__ import annotations
 
@@ -123,6 +124,12 @@ def delete_run_record(domain_id: str, workflow_id: str, run_id: str) -> dict:
     return {"t": "delw", "d": domain_id, "w": workflow_id, "r": run_id}
 
 
+def config_record(key: str, value, domain=None) -> dict:
+    """Dynamic-config write (the configstore analog): the CLI persists
+    operator config changes so every later invocation sees them."""
+    return {"t": "cfg", "k": key, "v": value, "dom": domain}
+
+
 def domain_record(info: DomainInfo) -> dict:
     return {"t": "d", "id": info.domain_id, "name": info.name,
             "ret": info.retention_days, "act": info.is_active,
@@ -217,6 +224,7 @@ def recover_stores(path: str, verify_on_device: bool = True,
     owner's) and runs the task refresher for open workflows.
     """
     stores = Stores()
+    stores.recovered_config = []
     for rec in DurableLog.read_all(path):
         t = rec["t"]
         if t == "d":
@@ -252,6 +260,9 @@ def recover_stores(path: str, verify_on_device: bool = True,
             # retention tombstone: the run's history and snapshot stay dead
             stores.history.delete_run(rec["d"], rec["w"], rec["r"])
             stores.execution.delete_workflow(rec["d"], rec["w"], rec["r"])
+        elif t == "cfg":
+            stores.recovered_config.append(
+                (rec["k"], rec["v"], rec.get("dom")))
         elif t == "cur":
             stores.execution.restore_current(
                 rec["d"], rec["w"],
@@ -352,14 +363,24 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
             report.open_workflows += 1
         # visibility is DERIVED data (the reference reindexes ES from
         # history); rebuild the records here instead of logging them.
-        # Close time approximates to the completion event's timestamp.
+        # Only runs holding the current pointer (or closed runs) get
+        # records: zombies and orphan history from failed starts must not
+        # surface as phantom open workflows. Close time approximates to
+        # the completion event's timestamp.
         from .persistence import VisibilityRecord
         info = ms.execution_info
-        stores.visibility.record_started(VisibilityRecord(
-            domain_id=key[0], workflow_id=key[1], run_id=key[2],
-            workflow_type=info.workflow_type_name,
-            start_time=info.start_timestamp))
-        if info.state == WorkflowState.Completed:
+        try:
+            is_current = (stores.execution.get_current_run_id(
+                key[0], key[1]) == key[2])
+        except Exception:
+            is_current = False
+        closed = info.state == WorkflowState.Completed
+        if is_current or closed:
+            stores.visibility.record_started(VisibilityRecord(
+                domain_id=key[0], workflow_id=key[1], run_id=key[2],
+                workflow_type=info.workflow_type_name,
+                start_time=info.start_timestamp))
+        if closed:
             events = stores.history.read_events(*key)
             stores.visibility.record_closed(
                 *key, close_time=events[-1].timestamp if events else 0,

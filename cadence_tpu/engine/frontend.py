@@ -202,12 +202,20 @@ class Frontend:
                 queries=engine.queries.attach(key), query_only=True,
                 execution=key)
         from .history_engine import InvalidRequestError
+        from .persistence import EntityNotExistsError
         try:
             token = engine.record_decision_task_started(
                 task.domain_id, task.workflow_id, task.run_id,
                 task.schedule_id, request_id=str(uuid.uuid4()))
-        except InvalidRequestError:
-            return None  # stale task (decision already handled) — skip
+        except (InvalidRequestError, EntityNotExistsError):
+            # stale task (decision handled / run never committed) — drop
+            return None
+        except Exception:
+            # transient engine/store failure: the consumed task must not be
+            # lost — requeue for redelivery (matching acks only after a
+            # successful RecordDecisionTaskStarted)
+            self.matching.requeue_task(task, TASK_LIST_TYPE_DECISION)
+            raise
         ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
                                       task.run_id)
         history = engine.get_history(task.domain_id, task.workflow_id,
@@ -319,12 +327,16 @@ class Frontend:
             return None
         engine = self.router(task.workflow_id)
         from .history_engine import InvalidRequestError
+        from .persistence import EntityNotExistsError
         try:
             token = engine.record_activity_task_started(
                 task.domain_id, task.workflow_id, task.run_id,
                 task.schedule_id, request_id=str(uuid.uuid4()))
-        except InvalidRequestError:
-            return None  # stale (activity timed out / workflow closed)
+        except (InvalidRequestError, EntityNotExistsError):
+            return None  # stale (timed out / closed / never committed)
+        except Exception:
+            self.matching.requeue_task(task, TASK_LIST_TYPE_ACTIVITY)
+            raise
         ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
                                       task.run_id)
         ai = ms.pending_activity_info_ids.get(task.schedule_id)

@@ -294,6 +294,19 @@ class HistoryEngine:
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_START_WORKFLOW, m.M_REQUESTS)
         run_id = run_id or str(uuid.uuid4())
+        # duplicate check BEFORE any write (the create fence still guards
+        # the race): a rejected duplicate must not leave orphan history
+        try:
+            cur = self.stores.execution.get_current_run_id(domain_id,
+                                                           workflow_id)
+            cur_ms = self.stores.execution.get_workflow(domain_id,
+                                                        workflow_id, cur)
+            if cur_ms.execution_info.state != WorkflowState.Completed:
+                from .persistence import WorkflowAlreadyStartedError
+                raise WorkflowAlreadyStartedError(
+                    f"{workflow_id}: run {cur} still open")
+        except EntityNotExistsError:
+            pass
         ms = MutableState(self._domain_entry(domain_id))
         version = ms.domain_entry.failover_version
         now = self.clock.now()
@@ -344,10 +357,16 @@ class HistoryEngine:
         sb = StateBuilder(ms)
         sb.apply_batch(batch)
 
-        self.shard.create_workflow(ms)
-        self.stores.history.append_batch(domain_id, workflow_id, run_id, events)
+        # history FIRST (the reference's events-first ordering,
+        # context.go PersistStartWorkflowBatchEvents before
+        # CreateWorkflowExecution): a failure between the two leaves only
+        # orphan history under a never-registered run ID — harmless; the
+        # execution row is the commit point, so a retried start (fresh run
+        # ID) starts clean
+        self.shard.append_history(domain_id, workflow_id, run_id, events)
         self.shard.insert_tasks(domain_id, workflow_id, run_id,
                                 ms.transfer_tasks, ms.timer_tasks)
+        self.shard.create_workflow(ms)  # commit point
         ms.transfer_tasks, ms.timer_tasks = [], []
         self._publish_replication(domain_id, workflow_id, run_id, events, ms)
         self.notifier.notify((domain_id, workflow_id, run_id),
@@ -879,9 +898,9 @@ class HistoryEngine:
         info = ms.execution_info
         transfer, timer = list(ms.transfer_tasks), list(ms.timer_tasks)
         ms.transfer_tasks, ms.timer_tasks = [], []
-        self.shard.update_workflow(ms, expected_next_event_id)
         self.shard.insert_tasks(info.domain_id, info.workflow_id,
                                 info.run_id, transfer, timer)
+        self.shard.update_workflow(ms, expected_next_event_id)
 
     # ------------------------------------------------------------------
     # Signals / cancel / terminate (historyEngine.go:2202,:2629 region)
@@ -1024,14 +1043,16 @@ class HistoryEngine:
         timer = list(new_ms.timer_tasks)
         new_ms.transfer_tasks, new_ms.timer_tasks = [], []
 
-        self.shard.create_workflow(new_ms)
+        # history first, execution row as the commit point (see
+        # start_workflow's ordering note)
         for pb in prefix:
-            self.stores.history.append_batch(domain_id, workflow_id,
-                                             new_run_id, pb.events)
-        self.stores.history.append_batch(domain_id, workflow_id, new_run_id,
-                                         txn.events)
+            self.shard.append_history(domain_id, workflow_id, new_run_id,
+                                      pb.events)
+        self.shard.append_history(domain_id, workflow_id, new_run_id,
+                                  txn.events)
         self.shard.insert_tasks(domain_id, workflow_id, new_run_id,
                                 transfer, timer)
+        self.shard.create_workflow(new_ms)  # commit point
         self._publish_replication(domain_id, workflow_id, new_run_id,
                                   txn.events, new_ms)
         self.notifier.notify((domain_id, workflow_id, new_run_id),
@@ -1417,17 +1438,18 @@ class _Txn:
         # tasks are drained into the shard queues at commit; the persisted
         # snapshot must not accumulate them across transactions
         self.ms.transfer_tasks, self.ms.timer_tasks = [], []
-        # fenced conditional update FIRST: if this owner was deposed or the
-        # state moved underneath us, nothing is persisted — appending history
-        # first would orphan events in the strictly-contiguous branch and
-        # wedge the workflow (the reference's range-ID fence rejects at the
-        # same point, shard/context.go:586-700)
-        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
-        self.engine.stores.history.append_batch(
+        # reference write order (context.go): events first, then tasks,
+        # then the fenced conditional state update as the COMMIT POINT
+        # (shard/context.go:586-700 range-ID fence). A failure before the
+        # update leaves only harmless garbage: an orphan history tail that
+        # the next append OVERWRITES (append_batch's node-overwrite
+        # semantics) and stale tasks the executors' guards drop.
+        self.engine.shard.append_history(
             info.domain_id, info.workflow_id, info.run_id, self.events)
         self.engine.shard.insert_tasks(
             info.domain_id, info.workflow_id, info.run_id,
             new_transfer, new_timer)
+        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
         self.engine._publish_replication(info.domain_id, info.workflow_id,
                                          info.run_id, self.events, self.ms)
         # wake history long-polls (events/notifier.go NotifyNewHistoryEvent)

@@ -184,10 +184,16 @@ class _TaskListManager:
         if not self._buffer:
             return None
         task = self._buffer.popleft()
-        self._ack = task.task_id
-        self._stores.task.complete_tasks_less_than(
-            self._info.domain_id, self._info.name, self._info.task_type,
-            self._ack)
+        self._ack = max(self._ack, task.task_id)
+        try:
+            # completed-task GC is BEST-EFFORT (taskGC batches deletions
+            # and tolerates failures): a failed ack must never lose the
+            # popped task — the rows get re-deleted by a later ack
+            self._stores.task.complete_tasks_less_than(
+                self._info.domain_id, self._info.name, self._info.task_type,
+                self._ack)
+        except Exception:
+            pass
         return task
 
     def poll(self) -> Optional[PersistedTask]:
@@ -394,6 +400,16 @@ class MatchingEngine:
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
                            run_id=task.run_id, schedule_id=task.schedule_id,
                            task_list=task_list)
+
+    def requeue_task(self, task: MatchedTask, task_type: int) -> None:
+        """Return a delivered-but-unprocessed task (the engine write behind
+        it failed) to the FRONT of its base task list's root backlog — the
+        reference only acks a matched task after successful delivery, so a
+        failed RecordTaskStarted redelivers."""
+        mgr = self._manager(task.domain_id, task.task_list, task_type)
+        mgr.requeue_front(PersistedTask(
+            task_id=0, domain_id=task.domain_id, workflow_id=task.workflow_id,
+            run_id=task.run_id, schedule_id=task.schedule_id))
 
     def describe_task_list(self, domain_id: str, task_list: str,
                            task_type: int) -> Dict[str, int]:

@@ -128,6 +128,14 @@ class HistoryStore:
     def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
                      events: List[HistoryEvent],
                      branch: Optional[int] = None) -> None:
+        """Append a batch; contiguity enforced per branch.
+
+        Re-appending at an id the branch already holds OVERWRITES the tail
+        from that id (Cassandra history-node overwrite semantics,
+        nosqlHistoryStore.go AppendHistoryNodes): a transaction that
+        appended its events but failed before its state-update commit
+        point retries by rewriting the same ids — the torn tail must not
+        wedge the branch. A gap (first id beyond the tail) still fails."""
         if not events:
             raise ValueError("empty history batch")
         key = (domain_id, workflow_id, run_id)
@@ -137,13 +145,27 @@ class HistoryStore:
             if index >= len(branches):
                 raise EntityNotExistsError(f"no branch {index} for {key}")
             target = branches[index]
+            first = events[0].id
             if target:
                 expected = target[-1][-1].id + 1
-                if events[0].id != expected:
+                if first > expected:
                     raise ConditionFailedError(
                         f"history append out of order: got first id "
-                        f"{events[0].id}, expected {expected}"
+                        f"{first}, expected {expected}"
                     )
+                if first < expected:
+                    # overwrite: drop the tail from `first` on
+                    while target and target[-1][0].id >= first:
+                        target.pop()
+                    if target and target[-1][-1].id >= first:
+                        kept = [e for e in target[-1] if e.id < first]
+                        if kept:
+                            target[-1] = kept
+                        else:
+                            target.pop()
+                    if target and target[-1][-1].id + 1 != first:
+                        raise ConditionFailedError(
+                            f"history overwrite leaves a gap before {first}")
             target.append(list(events))
             if self._wal is not None:
                 from .durability import history_record

@@ -101,13 +101,17 @@ class DeviceRebuilder:
             scope.inc(m.M_KERNEL_LAUNCHES)
             scope.inc(m.M_EVENTS_REPLAYED, total_events)
         except RuntimeError:
-            # no usable accelerator backend (e.g. the CLI on a machine
-            # whose JAX_PLATFORMS points at an unavailable plugin):
-            # recovery must still work — everything goes to the oracle,
-            # counted as fallbacks
-            self.stats.oracle_fallback += len(jobs)
-            scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
-            return [self._oracle_rebuild(b, e) for b, e in jobs]
+            # only a MISSING BACKEND degrades to the oracle (e.g. the CLI
+            # on a machine whose JAX_PLATFORMS points at an unavailable
+            # plugin); genuine kernel/compile/OOM failures must surface,
+            # not silently fall back — probe the backend to tell them apart
+            try:
+                jax.local_devices()
+            except RuntimeError:
+                self.stats.oracle_fallback += len(jobs)
+                scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
+                return [self._oracle_rebuild(b, e) for b, e in jobs]
+            raise
 
         out: List[MutableState] = []
         for i, (batches, entry) in enumerate(jobs):

@@ -99,6 +99,24 @@ class ShardContext:
                 self._closed = True
                 raise
 
+    def append_history(self, domain_id: str, workflow_id: str, run_id: str,
+                       events, branch=None) -> None:
+        """Fenced history append: a deposed owner must NOT reach the
+        history store — with node-overwrite append semantics a stale
+        writer could truncate committed events before its state update
+        hits the range fence. Ownership is re-validated against the shard
+        store's CURRENT range id, the same check every write makes."""
+        with self._lock:
+            self._ensure_open()
+            current = self._stores.shard.get_or_create(self.shard_id)
+            if current.range_id != self._info.range_id:
+                self._closed = True
+                raise ShardOwnershipLostError(
+                    f"shard {self.shard_id}: append fenced (range "
+                    f"{self._info.range_id} != {current.range_id})")
+            self._stores.history.append_batch(domain_id, workflow_id,
+                                              run_id, events, branch=branch)
+
     def update_workflow(self, ms: MutableState, expected_next_event_id: int) -> None:
         with self._lock:
             self._ensure_open()

@@ -109,11 +109,11 @@ class TestCLI:
         rc, out = self._run(capsys, "--wal", wal, "admin", "config-set",
                             "--key", "frontend.rps", "--value", "25")
         assert rc == 0 and out["frontend.rps"] == 25
-        # note: config is per-process (the reference's configstore persists
-        # it; ours lives with the host) — the get below reads the default
+        # the WAL-persisted config survives to the next CLI invocation
+        # (the configstore analog)
         rc, out = self._run(capsys, "--wal", wal, "admin", "config-get",
                             "--key", "frontend.rps")
-        assert rc == 0
+        assert rc == 0 and out["frontend.rps"] == 25
 
     def test_cli_describe_cluster(self, tmp_path, capsys):
         wal = str(tmp_path / "cluster.wal")

@@ -1,0 +1,164 @@
+"""Persistence fault injection (VERDICT missing #10).
+
+Reference: persistenceErrorInjectionClients.go:51-101 — every manager
+wrapped with configurable error injection; callers' retry semantics get
+exercised against REAL mid-transaction failures, and the scanner detects
+what a torn write leaves behind.
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus
+from cadence_tpu.engine.faults import (
+    FaultInjector,
+    TransientStoreError,
+    inject_faults,
+    instrument_stores,
+)
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import CompleteDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "fault-domain"
+TL = "fault-tl"
+
+
+def make_box(injector=None):
+    box = Onebox(num_hosts=1, num_shards=4)
+    if injector is not None:
+        inject_faults(box.stores, injector, metrics=box.metrics)
+    box.frontend.register_domain(DOMAIN)
+    return box
+
+
+class TestScriptedFaults:
+    def test_failed_create_leaves_no_state_and_retry_succeeds(self):
+        injector = FaultInjector()
+        box = make_box(injector)
+        injector.fail_next("execution", "create_workflow")
+        with pytest.raises(TransientStoreError):
+            box.frontend.start_workflow_execution(DOMAIN, "f-1", "t", TL)
+        # nothing persisted: the id is still startable and no history exists
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        assert (domain_id, "f-1") not in dict(
+            box.stores.execution.list_current_pointers())
+        box.frontend.start_workflow_execution(DOMAIN, "f-1", "t", TL)
+        TaskPoller(box, DOMAIN, TL, {"f-1": CompleteDecider()}).drain()
+        assert box.tpu.verify_all().ok
+
+    def test_failed_update_mid_transaction_is_clean(self):
+        """An injected failure at the commit point leaves committed STATE
+        untouched; the retried request overwrites the torn history tail
+        and lands cleanly."""
+        injector = FaultInjector()
+        box = make_box(injector)
+        box.frontend.start_workflow_execution(DOMAIN, "f-2", "signal", TL)
+        injector.fail_next("execution", "update_workflow")
+        with pytest.raises(TransientStoreError):
+            box.frontend.signal_workflow_execution(DOMAIN, "f-2", "sig")
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "f-2")
+        ms = box.stores.execution.get_workflow(domain_id, "f-2", run_id)
+        assert ms.execution_info.signal_count == 0  # nothing applied
+        box.frontend.signal_workflow_execution(DOMAIN, "f-2", "sig")
+        ms = box.stores.execution.get_workflow(domain_id, "f-2", run_id)
+        assert ms.execution_info.signal_count == 1
+        assert box.tpu.verify_all().ok
+
+    def test_torn_tail_detected_then_healed_by_retry(self):
+        """A fault at the COMMIT POINT (the conditional state update, last
+        write of a transaction) leaves an orphan history tail — the
+        scanner's device-replay invariant flags it, and the caller's retry
+        OVERWRITES the tail (append node-overwrite semantics) and commits,
+        after which the cluster verifies clean."""
+        injector = FaultInjector()
+        box = make_box(injector)
+        box.frontend.start_workflow_execution(DOMAIN, "f-3", "signal", TL)
+        injector.fail_next("execution", "update_workflow")
+        with pytest.raises(TransientStoreError):
+            box.frontend.signal_workflow_execution(DOMAIN, "f-3", "sig")
+        report = box.scanner.run_once()
+        assert not report.ok
+        assert len(report.state_divergent) == 1
+        # retry heals: same event ids rewrite the torn tail, then commit
+        box.frontend.signal_workflow_execution(DOMAIN, "f-3", "sig")
+        assert box.scanner.run_once().ok
+
+    def test_injected_faults_counted_in_metrics(self):
+        injector = FaultInjector()
+        box = make_box(injector)
+        injector.fail_next("execution", "create_workflow")
+        with pytest.raises(TransientStoreError):
+            box.frontend.start_workflow_execution(DOMAIN, "f-4", "t", TL)
+        assert box.metrics.counter("persistence.execution",
+                                   "errors-injected") == 1
+
+
+class TestRateFaults:
+    def test_workload_survives_random_write_faults_with_retries(self):
+        """10% write-failure rate; a client-side retry tier (the reference
+        wraps every service client in retryable decorators) pushes every
+        workflow to completion and the cluster verifies clean."""
+        injector = FaultInjector(rate=0.1, seed=42)
+        box = make_box(injector)
+
+        from cadence_tpu.engine.persistence import WorkflowAlreadyStartedError
+
+        def retry(fn, attempts=8):
+            for i in range(attempts):
+                try:
+                    return fn()
+                except TransientStoreError:
+                    continue
+                except WorkflowAlreadyStartedError:
+                    # a prior attempt's create committed (with history-first
+                    # ordering the run is fully usable): treat as success
+                    return None
+            raise AssertionError("retries exhausted")
+
+        for i in range(6):
+            retry(lambda i=i: box.frontend.start_workflow_execution(
+                DOMAIN, f"rf-{i}", "t", TL))
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {f"rf-{i}": CompleteDecider() for i in range(6)})
+        # drive manually with retries (drain() assumes a fault-free pump):
+        # a failed record-started requeues the task; a failed respond loses
+        # the worker's answer, and the decision's start-to-close timeout
+        # re-dispatches it — so the clock advances every round
+        for _ in range(300):
+            retry(lambda: box.pump_once())
+            while True:
+                try:
+                    if not poller.poll_and_decide_once():
+                        break
+                except TransientStoreError:
+                    continue
+            while True:
+                try:
+                    if not poller.poll_and_run_activity_once():
+                        break
+                except TransientStoreError:
+                    continue
+            box.advance_time(11)  # decision timeout: 10s
+            if box.matching.backlog() == 0 and retry(lambda: box.pump_once()) == 0:
+                break
+        assert injector.injected > 0
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        done = 0
+        for i in range(6):
+            run = box.stores.execution.get_current_run_id(domain_id, f"rf-{i}")
+            ms = box.stores.execution.get_workflow(domain_id, f"rf-{i}", run)
+            if ms.execution_info.close_status == CloseStatus.Completed:
+                done += 1
+        assert done == 6
+        assert box.tpu.verify_all().ok
+
+
+class TestMetricsDecorator:
+    def test_store_call_counters(self):
+        box = Onebox(num_hosts=1, num_shards=2)
+        instrument_stores(box.stores, box.metrics)
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "m-1", "t", TL)
+        assert box.metrics.counter("persistence.execution", "requests") > 0
+        assert box.metrics.counter("persistence.history", "requests") > 0
+        assert box.metrics.counter("persistence.domain", "requests") > 0
