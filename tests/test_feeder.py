@@ -1,0 +1,103 @@
+"""Pipelined feeder + resharding (VERDICT ask #9).
+
+wire bytes → C++ packer → device replay chunks, double-buffered; and
+shard-movement invariance: the same corpus on differently-shaped meshes
+yields identical payloads.
+"""
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import crc32_of_rows
+from cadence_tpu.gen.corpus import SUITES, generate_corpus
+from cadence_tpu.native import packing
+from cadence_tpu.native.feeder import feed_corpus, feed_serialized
+from cadence_tpu.ops.encode import encode_corpus, history_length
+from cadence_tpu.ops.replay import replay_corpus
+
+needs_native = pytest.mark.skipif(not packing.native_available(),
+                                  reason="native packer unavailable")
+
+
+@needs_native
+class TestFeeder:
+    def test_feeder_matches_direct_replay(self):
+        """Chunked pipelined feed == one-shot replay, bit for bit."""
+        histories = []
+        for suite in SUITES:
+            histories.extend(generate_corpus(suite, num_workflows=6, seed=5,
+                                             target_events=40))
+        rows_direct, crcs_direct, errors_direct = replay_corpus(histories)
+
+        rows, errors, report = feed_corpus(histories, chunk_workflows=8)
+        assert (errors == errors_direct).all()
+        assert (rows == rows_direct).all()
+        assert (crc32_of_rows(rows) == crcs_direct).all()
+        assert report.workflows == len(histories)
+        assert report.chunks == -(-len(histories) // 8)
+        assert report.events_per_sec > 0
+        assert report.pack_events_per_sec >= report.events_per_sec
+
+    def test_feeder_pads_tail_chunk(self):
+        histories = generate_corpus("basic", num_workflows=5, seed=3,
+                                    target_events=30)
+        rows, errors, report = feed_corpus(histories, chunk_workflows=4)
+        assert rows.shape[0] == 5 and errors.shape[0] == 5
+        assert (errors == 0).all()
+        assert report.chunks == 2
+
+    def test_feeder_event_count_is_real(self):
+        histories = generate_corpus("basic", num_workflows=4, seed=9,
+                                    target_events=30)
+        total = sum(history_length(h) for h in histories)
+        _, _, report = feed_corpus(histories, chunk_workflows=4)
+        assert report.events == total
+
+
+class TestResharding:
+    def test_mesh_shapes_agree(self):
+        """Replay on an 8-device mesh, then a 2-device mesh, then a single
+        device: identical payload rows (shard movement never changes
+        state — the P1 axis is pure data parallelism)."""
+        import jax
+        import jax.numpy as jnp
+
+        from cadence_tpu.parallel.mesh import make_mesh, replay_sharded
+
+        histories = []
+        for suite in SUITES[:3]:
+            histories.extend(generate_corpus(suite, num_workflows=8, seed=11,
+                                             target_events=24))
+        events = jnp.asarray(encode_corpus(histories))
+        devices = jax.devices()
+        assert len(devices) >= 8  # conftest forces the 8-device CPU mesh
+
+        rows8, err8, _ = replay_sharded(events, make_mesh(devices[:8]))
+        rows2, err2, _ = replay_sharded(events, make_mesh(devices[:2]))
+        rows1, err1, _ = replay_sharded(events, make_mesh(devices[:1]))
+        rows8, rows2, rows1 = map(np.asarray, (rows8, rows2, rows1))
+        assert (np.asarray(err8) == 0).all()
+        assert (rows8 == rows2).all()
+        assert (rows8 == rows1).all()
+
+    def test_resharded_array_replays_identically(self):
+        """Move an ALREADY-SHARDED corpus to a different mesh (the
+        shard-steal path: device_put with a new sharding) and replay —
+        payloads unchanged."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from cadence_tpu.parallel.mesh import SHARD_AXIS, make_mesh, replay_sharded
+
+        histories = generate_corpus("echo_signal", num_workflows=16, seed=2,
+                                    target_events=24)
+        events = jnp.asarray(encode_corpus(histories))
+        devices = jax.devices()
+        mesh_a = make_mesh(devices[:8])
+        mesh_b = make_mesh(devices[4:8])  # different device set + shape
+
+        rows_a, _, _ = replay_sharded(events, mesh_a)
+        moved = jax.device_put(
+            events, NamedSharding(mesh_b, P(SHARD_AXIS, None, None)))
+        rows_b, _, _ = replay_sharded(moved, mesh_b)
+        assert (np.asarray(rows_a) == np.asarray(rows_b)).all()
