@@ -16,28 +16,29 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "packer.cc")
+_SRC_GEN = os.path.join(_DIR, "generator.cc")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 
 _lock = threading.Lock()
-_cached: Optional[ctypes.CDLL] = None
-_load_failed = False
+_cached: dict = {}
+_load_failed: set = set()
 
 
-def _so_path() -> str:
-    with open(_SRC, "rb") as f:
+def _so_path(src: str, stem: str) -> str:
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_BUILD_DIR, f"libcadence_packer_{digest}.so")
+    return os.path.join(_BUILD_DIR, f"lib{stem}_{digest}.so")
 
 
-def build(verbose: bool = False) -> str:
-    """Compile packer.cc if needed; returns the .so path."""
-    so = _so_path()
+def _build_src(src: str, stem: str, verbose: bool = False) -> str:
+    """Compile one source if needed; returns the .so path."""
+    so = _so_path(src, stem)
     if os.path.exists(so):
         return so
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-o", so + ".tmp", _SRC,
+        "-o", so + ".tmp", src,
     ]
     if verbose:
         print("+", " ".join(cmd))
@@ -46,19 +47,29 @@ def build(verbose: bool = False) -> str:
     return so
 
 
-def load() -> Optional[ctypes.CDLL]:
-    """Load (building if necessary); None when no toolchain is available."""
-    global _cached, _load_failed
+def build(verbose: bool = False) -> str:
+    return _build_src(_SRC, "cadence_packer", verbose)
+
+
+def _load_lib(src: str, stem: str, configure) -> Optional[ctypes.CDLL]:
     with _lock:
-        if _cached is not None:
-            return _cached
-        if _load_failed:
+        if stem in _cached:
+            return _cached[stem]
+        if stem in _load_failed:
             return None
         try:
-            lib = ctypes.CDLL(build())
+            lib = ctypes.CDLL(_build_src(src, stem))
         except (OSError, subprocess.CalledProcessError, FileNotFoundError):
-            _load_failed = True
+            _load_failed.add(stem)
             return None
+        configure(lib)
+        _cached[stem] = lib
+        return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load the packer (building if necessary); None without a toolchain."""
+    def configure(lib):
         lib.cadence_pack_corpus.restype = ctypes.c_int64
         lib.cadence_pack_corpus.argtypes = [
             ctypes.c_char_p,                  # blob
@@ -69,5 +80,20 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),   # out
             ctypes.c_int64,                   # num_threads
         ]
-        _cached = lib
-        return lib
+    return _load_lib(_SRC, "cadence_packer", configure)
+
+
+def load_generator() -> Optional[ctypes.CDLL]:
+    """Load the native corpus generator; None without a toolchain."""
+    def configure(lib):
+        lib.cadence_generate_corpus.restype = ctypes.c_int64
+        lib.cadence_generate_corpus.argtypes = [
+            ctypes.c_uint64,                  # seed
+            ctypes.c_int64,                   # first_index
+            ctypes.c_int64,                   # num_workflows
+            ctypes.c_int64,                   # max_events
+            ctypes.c_int64,                   # num_lanes
+            ctypes.POINTER(ctypes.c_int64),   # out
+            ctypes.c_int64,                   # num_threads
+        ]
+    return _load_lib(_SRC_GEN, "cadence_generator", configure)

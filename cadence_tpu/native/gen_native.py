@@ -1,0 +1,41 @@
+"""Python wrapper over the native corpus generator (generator.cc)."""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.encode import NUM_LANES
+from . import build as _build
+
+
+def generator_available() -> bool:
+    return _build.load_generator() is not None
+
+
+def generate_corpus_native(seed: int, first_index: int, num_workflows: int,
+                           max_events: int,
+                           num_threads: Optional[int] = None,
+                           out: Optional[np.ndarray] = None
+                           ) -> Tuple[np.ndarray, int]:
+    """Fill [num_workflows, max_events, NUM_LANES] with distinct histories
+    for global indices [first_index, first_index + num_workflows); returns
+    (lanes, real_event_count). Pass `out` to reuse a buffer in streaming
+    loops."""
+    lib = _build.load_generator()
+    if lib is None:
+        raise RuntimeError("native generator unavailable (no C++ toolchain)")
+    if num_threads is None:
+        num_threads = os.cpu_count() or 1
+    if out is None:
+        out = np.empty((num_workflows, max_events, NUM_LANES), dtype=np.int64)
+    else:
+        assert out.shape == (num_workflows, max_events, NUM_LANES)
+        assert out.dtype == np.int64
+    total = lib.cadence_generate_corpus(
+        ctypes.c_uint64(seed), first_index, num_workflows, max_events,
+        NUM_LANES, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        num_threads)
+    return out, int(total)

@@ -252,3 +252,95 @@ def encode_corpus(histories: Sequence[Sequence[HistoryBatch]],
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return np.stack([encode_history(h, max_events) for h in histories])
+
+
+# ---------------------------------------------------------------------------
+# Lane decoding (the packer's inverse, for oracle spot-parity on natively
+# generated corpora — string identifiers are synthesized from their
+# interned keys, which is payload-neutral: the canonical checksum payload
+# carries only numeric ids)
+# ---------------------------------------------------------------------------
+
+_DECODE_ATTRS = {
+    EventType.WorkflowExecutionStarted: (
+        "execution_start_to_close_timeout_seconds",
+        "task_start_to_close_timeout_seconds",
+        "first_decision_task_backoff_seconds", "attempt",
+        "expiration_timestamp", None, None, "initiator"),
+    EventType.DecisionTaskScheduled: (
+        "start_to_close_timeout_seconds", "attempt"),
+    EventType.DecisionTaskStarted: ("scheduled_event_id",),
+    EventType.DecisionTaskCompleted: ("scheduled_event_id",
+                                      "started_event_id"),
+    EventType.DecisionTaskTimedOut: ("timeout_type",),
+    EventType.ActivityTaskStarted: ("scheduled_event_id",),
+    EventType.ActivityTaskCompleted: ("scheduled_event_id",),
+    EventType.ActivityTaskFailed: ("scheduled_event_id",),
+    EventType.ActivityTaskTimedOut: ("scheduled_event_id",),
+    EventType.ActivityTaskCanceled: ("scheduled_event_id",),
+}
+_INITIATED_REF_TYPES = frozenset({
+    EventType.ChildWorkflowExecutionStarted,
+    EventType.StartChildWorkflowExecutionFailed,
+    EventType.ChildWorkflowExecutionCompleted,
+    EventType.ChildWorkflowExecutionFailed,
+    EventType.ChildWorkflowExecutionCanceled,
+    EventType.ChildWorkflowExecutionTimedOut,
+    EventType.ChildWorkflowExecutionTerminated,
+    EventType.RequestCancelExternalWorkflowExecutionFailed,
+    EventType.ExternalWorkflowExecutionCancelRequested,
+    EventType.SignalExternalWorkflowExecutionFailed,
+    EventType.ExternalWorkflowExecutionSignaled,
+})
+
+
+def decode_lanes(rows: np.ndarray, domain_id: str = "bench-domain",
+                 workflow_id: str = "wf", run_id: str = "run"
+                 ) -> List[HistoryBatch]:
+    """One workflow's [E, L] lanes → oracle-replayable batches."""
+    from ..core.events import HistoryEvent
+
+    batches: List[HistoryBatch] = []
+    events: List = []
+    for row in rows:
+        if row[LANE_EVENT_ID] <= 0:
+            continue
+        et = EventType(int(row[LANE_EVENT_TYPE]))
+        a = [int(v) for v in row[LANE_A0:LANE_A0 + NUM_ATTR_LANES]]
+        attrs = {}
+        if et == EventType.ActivityTaskScheduled:
+            attrs = dict(activity_id=f"act-{a[0]}",
+                         schedule_to_start_timeout_seconds=a[1],
+                         schedule_to_close_timeout_seconds=a[2],
+                         start_to_close_timeout_seconds=a[3],
+                         heartbeat_timeout_seconds=a[4])
+        elif et == EventType.ActivityTaskCancelRequested:
+            attrs = dict(activity_id=f"act-{a[0]}")
+        elif et == EventType.TimerStarted:
+            attrs = dict(timer_id=f"timer-{a[0]}",
+                         start_to_fire_timeout_seconds=a[1])
+        elif et in (EventType.TimerFired, EventType.TimerCanceled):
+            attrs = dict(timer_id=f"timer-{a[0]}")
+        elif et in _INITIATED_REF_TYPES:
+            attrs = dict(initiated_event_id=a[0])
+        else:
+            names = _DECODE_ATTRS.get(et, ())
+            for i, name in enumerate(names):
+                if name is not None:
+                    attrs[name] = a[i]
+            if et == EventType.WorkflowExecutionStarted:
+                if attrs.get("initiator") == -1:
+                    attrs.pop("initiator")
+        events.append(HistoryEvent(
+            id=int(row[LANE_EVENT_ID]), event_type=et,
+            version=int(row[LANE_VERSION]),
+            timestamp=int(row[LANE_TIMESTAMP]),
+            task_id=int(row[LANE_TASK_ID]), attrs=attrs))
+        if row[LANE_BATCH_LAST] == 1:
+            batches.append(HistoryBatch(
+                domain_id=domain_id, workflow_id=workflow_id,
+                run_id=run_id, events=events))
+            events = []
+    if events:
+        raise ValueError("lanes end mid-batch (no batch_last marker)")
+    return batches

@@ -151,8 +151,15 @@ def state_transition_valid(cur_state, cur_close, new_state, new_close):
 # ---------------------------------------------------------------------------
 
 
-def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
-    """Apply one event (lanes [W, L]) to all workflows. Returns new state."""
+def step(s: ReplayState, ev: jnp.ndarray,
+         enable_reset: bool = True) -> ReplayState:
+    """Apply one event (lanes [W, L]) to all workflows. Returns new state.
+
+    `enable_reset` statically compiles the continue-as-new run-boundary
+    blend in or out: corpora that never set FLAG_RUN_RESET (e.g. the
+    device-side generator) skip it entirely — and lax.cond's
+    varying-manual-axes typing doesn't mix with shard_map, which the
+    sharded fused kernel uses."""
     ev_id = ev[:, LANE_EVENT_ID]
     etype = ev[:, LANE_EVENT_TYPE]
     ev_version = ev[:, LANE_VERSION]
@@ -170,11 +177,12 @@ def step(s: ReplayState, ev: jnp.ndarray) -> ReplayState:
     # mutableStateBuilder for newRunHistory); sticky errors survive the
     # reset. lax.cond keeps the full-state blend off the hot path for the
     # (typical) steps where no workflow crosses a run boundary.
-    import jax
+    if enable_reset:
+        import jax
 
-    do_reset = (ev_id > 0) & (s.error == 0) & ((flags & FLAG_RUN_RESET) != 0)
-    s = jax.lax.cond(do_reset.any(), lambda st: reset_rows(st, do_reset),
-                     lambda st: st, s)
+        do_reset = (ev_id > 0) & (s.error == 0) & ((flags & FLAG_RUN_RESET) != 0)
+        s = jax.lax.cond(do_reset.any(), lambda st: reset_rows(st, do_reset),
+                         lambda st: st, s)
 
     live = (ev_id > 0) & (s.error == 0)
     vh_only = (flags & FLAG_VH_ONLY) != 0

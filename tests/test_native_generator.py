@@ -1,0 +1,68 @@
+"""Native (C++) corpus generator parity (native/generator.cc).
+
+The host-side bulk generator for tooling and CPU-cluster runs (the bench's
+north star uses the DEVICE generator in ops/genkernel.py). Same contract:
+distinct, reproducible, oracle-valid histories in the packed lane schema.
+"""
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import STICKY_ROW_INDEX, payload_row
+from cadence_tpu.core.enums import EventType, WorkflowState
+from cadence_tpu.native.gen_native import (
+    generate_corpus_native,
+    generator_available,
+)
+from cadence_tpu.ops.encode import decode_lanes
+from cadence_tpu.oracle.state_builder import StateBuilder
+
+pytestmark = pytest.mark.skipif(not generator_available(),
+                                reason="no C++ toolchain")
+
+W, E = 48, 200
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    lanes, total = generate_corpus_native(seed=5, first_index=0,
+                                          num_workflows=W, max_events=E)
+    return lanes, total
+
+
+class TestNativeGenerator:
+    def test_distinct_and_reproducible(self, corpus):
+        lanes, total = corpus
+        assert total > W * E // 2
+        assert len({lanes[i].tobytes() for i in range(W)}) == W
+        again, total2 = generate_corpus_native(5, 0, W, E)
+        assert total2 == total and (again == lanes).all()
+
+    def test_first_index_is_seamless(self, corpus):
+        lanes, _ = corpus
+        tail, _ = generate_corpus_native(5, 24, W - 24, E)
+        assert (tail == lanes[24:]).all()
+
+    def test_oracle_valid_and_device_parity(self, corpus):
+        import jax.numpy as jnp
+
+        from cadence_tpu.ops.replay import replay_to_payload
+
+        lanes, _ = corpus
+        rows, errors = map(np.asarray,
+                           replay_to_payload(jnp.asarray(lanes)))
+        assert (errors == 0).all()
+        for i in range(0, W, 6):
+            ms = StateBuilder().replay_history(decode_lanes(lanes[i]))
+            expected = payload_row(ms)
+            expected[STICKY_ROW_INDEX] = 0
+            assert (rows[i] == expected).all(), f"workflow {i} diverged"
+            assert ms.execution_info.state == WorkflowState.Completed
+            assert not ms.pending_activity_info_ids
+            assert not ms.pending_timer_info_ids
+
+    def test_histories_close_cleanly(self, corpus):
+        lanes, _ = corpus
+        for i in range(W):
+            real = lanes[i][lanes[i][:, 0] > 0]
+            assert real[0][1] == int(EventType.WorkflowExecutionStarted)
+            assert real[-1][1] == int(EventType.WorkflowExecutionCompleted)
