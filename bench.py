@@ -99,6 +99,9 @@ def _north_star(workflows: int, max_events: int, chunk: int, seed: int,
     from cadence_tpu.parallel.mesh import make_mesh
 
     n_devices = jax.device_count()
+    # CI-scale requests smaller than a chunk shrink the chunk instead of
+    # silently inflating the run
+    chunk = min(chunk, max(workflows, n_devices))
     if n_devices > 1:
         # multi-chip: SPMD over the mesh — every chip generates+replays its
         # own workflow-index range (chunk must divide by the mesh)

@@ -459,16 +459,28 @@ class HistoryEngine:
             self.queries.complete(qkey, qid, qres)
         self.queries.requeue_started(qkey)
 
-        if ms.buffered_events and any(d.decision_type in self._CLOSE_DECISIONS
-                                      for d in decisions):
-            # UnhandledDecision: the close must not race the buffer; the
-            # flushed events force a REAL follow-up decision (attempt 0,
-            # mutable_state_decision_task_manager.go:373-382)
+        # attribute validation FIRST (decision/checker.go): one malformed
+        # decision fails the whole decision task with a typed cause and
+        # the worker re-decides — never a replay-transaction crash
+        from .checker import BadDecisionAttributes, validate_decision
+        fail_cause = None
+        try:
+            for d in decisions:
+                validate_decision(d, info.workflow_timeout)
+        except BadDecisionAttributes as bad:
+            fail_cause = bad.cause
+        if fail_cause is None and ms.buffered_events and any(
+                d.decision_type in self._CLOSE_DECISIONS for d in decisions):
+            # UnhandledDecision: the close must not race the buffer
+            fail_cause = "UNHANDLED_DECISION"
+        if fail_cause is not None:
+            # the flushed events force a REAL follow-up decision (attempt
+            # 0, mutable_state_decision_task_manager.go:373-382)
             txn = self._new_transaction(ms)
             txn.add(EventType.DecisionTaskFailed,
                     scheduled_event_id=token.schedule_id,
                     started_event_id=token.started_id,
-                    cause="UNHANDLED_DECISION")
+                    cause=fail_cause)
             self._flush_and_reschedule(txn, ms)
             txn.commit(expected)
             return

@@ -32,8 +32,13 @@ def generate_corpus_native(seed: int, first_index: int, num_workflows: int,
     if out is None:
         out = np.empty((num_workflows, max_events, NUM_LANES), dtype=np.int64)
     else:
-        assert out.shape == (num_workflows, max_events, NUM_LANES)
-        assert out.dtype == np.int64
+        # explicit raises (asserts vanish under -O) + contiguity: the C++
+        # writer streams row-major int64s from the base pointer
+        if out.shape != (num_workflows, max_events, NUM_LANES):
+            raise ValueError(f"out buffer shape {out.shape} != "
+                             f"{(num_workflows, max_events, NUM_LANES)}")
+        if out.dtype != np.int64 or not out.flags["C_CONTIGUOUS"]:
+            raise ValueError("out buffer must be C-contiguous int64")
     total = lib.cadence_generate_corpus(
         ctypes.c_uint64(seed), first_index, num_workflows, max_events,
         NUM_LANES, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

@@ -344,29 +344,24 @@ def generate_and_replay(seed: int, first_index: int, num_workflows: int,
     return _fused_scan(g0, s0, seed, first_index, total_events, layout)
 
 
-def generate_and_replay_sharded(seed: int, first_index: int,
-                                num_workflows: int, total_events: int,
-                                mesh,
-                                layout: PayloadLayout = DEFAULT_LAYOUT):
-    """SPMD north-star step over a device mesh: every device runs the fused
-    generator+replay on its own workflow-index range (pure data
-    parallelism — per-workflow RNG streams make shards independent), so a
-    multi-chip host actually exercises all chips. Workflow count must
-    divide by the mesh size. Identical outputs to the single-device path
-    for the same (seed, index) range."""
+#: compiled sharded executables keyed by (mesh, local_W, E, layout) —
+#: rebuilt closures would defeat the jit cache and recompile every call
+_SHARDED_CACHE: dict = {}
+
+
+def _sharded_fn(mesh, local: int, total_events: int,
+                layout: PayloadLayout):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    n = mesh.devices.size
-    if num_workflows % n:
-        raise ValueError(f"workflows {num_workflows} not divisible by "
-                         f"mesh size {n}")
-    local = num_workflows // n
-    offsets = jnp.asarray(first_index + jnp.arange(n) * local, I64)
-
     from .state import init_state
 
-    def local_fn(offset):
+    key = (mesh, local, total_events, layout)
+    fn = _SHARDED_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def local_fn(seed, offset):
         first = offset[0]
         # mark the constant-built initial carries as varying across the
         # mesh (each shard's trajectory differs), or scan/cond typing
@@ -384,6 +379,29 @@ def generate_and_replay_sharded(seed: int, first_index: int,
         s0 = varying(init_state(local, layout))
         return _fused_scan(g0, s0, seed, first, total_events, layout)
 
-    fn = jax.jit(shard_map(local_fn, mesh=mesh, in_specs=(P("shard"),),
+    fn = jax.jit(shard_map(local_fn, mesh=mesh, in_specs=(None, P("shard")),
                            out_specs=(P("shard"), P("shard"))))
-    return fn(offsets)
+    _SHARDED_CACHE[key] = fn
+    return fn
+
+
+def generate_and_replay_sharded(seed: int, first_index: int,
+                                num_workflows: int, total_events: int,
+                                mesh,
+                                layout: PayloadLayout = DEFAULT_LAYOUT):
+    """SPMD north-star step over a device mesh: every device runs the fused
+    generator+replay on its own workflow-index range (pure data
+    parallelism — per-workflow RNG streams make shards independent), so a
+    multi-chip host actually exercises all chips. Workflow count must
+    divide by the mesh size. Identical outputs to the single-device path
+    for the same (seed, index) range. The compiled executable is cached
+    per (mesh, shape): seed and offsets are traced arguments, so repeated
+    chunks reuse it."""
+    n = mesh.devices.size
+    if num_workflows % n:
+        raise ValueError(f"workflows {num_workflows} not divisible by "
+                         f"mesh size {n}")
+    local = num_workflows // n
+    offsets = jnp.asarray(first_index + jnp.arange(n) * local, I64)
+    fn = _sharded_fn(mesh, local, total_events, layout)
+    return fn(jnp.int64(seed), offsets)

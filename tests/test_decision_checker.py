@@ -1,0 +1,140 @@
+"""Decision attribute validation (VERDICT r2 weak #9; decision/checker.go).
+
+Malformed decisions fail the decision task with a typed cause and the
+worker re-decides; valid-but-sparse activity timeouts get the reference's
+deduction/defaulting.
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType, EventType
+from cadence_tpu.engine.checker import BadDecisionAttributes, validate_decision
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.onebox import Onebox
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "check-domain"
+TL = "check-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _poll(box, wf):
+    box.pump_once()
+    resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+    assert resp is not None and resp.token.workflow_id == wf
+    return resp
+
+
+class TestValidator:
+    def test_activity_requires_id(self):
+        d = Decision(DecisionType.ScheduleActivityTask,
+                     dict(schedule_to_close_timeout_seconds=30))
+        with pytest.raises(BadDecisionAttributes) as err:
+            validate_decision(d, 3600)
+        assert err.value.cause == "BAD_SCHEDULE_ACTIVITY_ATTRIBUTES"
+
+    def test_activity_negative_timeout_rejected(self):
+        d = Decision(DecisionType.ScheduleActivityTask,
+                     dict(activity_id="a",
+                          schedule_to_close_timeout_seconds=-5))
+        with pytest.raises(BadDecisionAttributes):
+            validate_decision(d, 3600)
+
+    def test_activity_no_deducible_timeout_rejected(self):
+        d = Decision(DecisionType.ScheduleActivityTask,
+                     dict(activity_id="a",
+                          schedule_to_start_timeout_seconds=10))
+        with pytest.raises(BadDecisionAttributes):
+            validate_decision(d, 3600)
+
+    def test_activity_timeout_deduction_from_s2c(self):
+        """checker.go:287-293 — schedule-to-close fills the missing pair."""
+        d = Decision(DecisionType.ScheduleActivityTask,
+                     dict(activity_id="a",
+                          schedule_to_close_timeout_seconds=30))
+        validate_decision(d, 3600)
+        assert d.attrs["schedule_to_start_timeout_seconds"] == 30
+        assert d.attrs["start_to_close_timeout_seconds"] == 30
+
+    def test_activity_timeout_deduction_sum_and_cap(self):
+        """checker.go:294-299 — s2c = s2s + stc, capped at wf timeout."""
+        d = Decision(DecisionType.ScheduleActivityTask,
+                     dict(activity_id="a",
+                          schedule_to_start_timeout_seconds=40,
+                          start_to_close_timeout_seconds=50))
+        validate_decision(d, 60)
+        assert d.attrs["schedule_to_close_timeout_seconds"] == 60  # capped
+        assert d.attrs["schedule_to_start_timeout_seconds"] == 40
+        assert d.attrs["start_to_close_timeout_seconds"] == 50
+
+    def test_timer_requires_positive_fire_timeout(self):
+        d = Decision(DecisionType.StartTimer,
+                     dict(timer_id="t", start_to_fire_timeout_seconds=0))
+        with pytest.raises(BadDecisionAttributes) as err:
+            validate_decision(d, 3600)
+        assert err.value.cause == "BAD_START_TIMER_ATTRIBUTES"
+
+    def test_child_and_signal_requirements(self):
+        with pytest.raises(BadDecisionAttributes):
+            validate_decision(Decision(
+                DecisionType.StartChildWorkflowExecution,
+                dict(workflow_type="t")), 3600)
+        with pytest.raises(BadDecisionAttributes):
+            validate_decision(Decision(
+                DecisionType.SignalExternalWorkflowExecution,
+                dict(workflow_id="w")), 3600)
+
+
+class TestEngineIntegration:
+    def test_bad_decision_fails_task_and_worker_retries(self, box):
+        """A malformed decision produces DecisionTaskFailed with the typed
+        cause (no transaction crash, no partial state); the retried
+        decision completes the workflow."""
+        box.frontend.start_workflow_execution(DOMAIN, "c-1", "t", TL)
+        resp = _poll(box, "c-1")
+        box.frontend.respond_decision_task_completed(
+            resp.token,
+            [Decision(DecisionType.ScheduleActivityTask,
+                      dict(schedule_to_close_timeout_seconds=30))])
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "c-1")
+        events = box.stores.history.read_events(domain_id, "c-1", run_id)
+        failed = [e for e in events
+                  if e.event_type == EventType.DecisionTaskFailed]
+        assert len(failed) == 1
+        assert failed[0].get("cause") == "BAD_SCHEDULE_ACTIVITY_ATTRIBUTES"
+        # no activity was scheduled
+        ms = box.stores.execution.get_workflow(domain_id, "c-1", run_id)
+        assert not ms.pending_activity_info_ids
+
+        # the transient retry dispatches; a good decision completes
+        box.pump_once()
+        resp2 = box.frontend.poll_for_decision_task(DOMAIN, TL)
+        assert resp2 is not None
+        box.frontend.respond_decision_task_completed(
+            resp2.token, [Decision(DecisionType.CompleteWorkflowExecution, {})])
+        ms = box.stores.execution.get_workflow(domain_id, "c-1", run_id)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_deduced_timeouts_reach_the_scheduled_event(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "c-2", "t", TL,
+                                              execution_timeout=120)
+        resp = _poll(box, "c-2")
+        box.frontend.respond_decision_task_completed(
+            resp.token,
+            [Decision(DecisionType.ScheduleActivityTask,
+                      dict(activity_id="a", task_list=TL,
+                           schedule_to_close_timeout_seconds=30))])
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "c-2")
+        ms = box.stores.execution.get_workflow(domain_id, "c-2", run_id)
+        ai = next(iter(ms.pending_activity_info_ids.values()))
+        assert ai.schedule_to_start_timeout == 30
+        assert ai.start_to_close_timeout == 30
+        assert ai.schedule_to_close_timeout == 30
