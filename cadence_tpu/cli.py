@@ -49,8 +49,11 @@ def _build_cluster(wal: str):
 
     if os.path.exists(wal):
         # commands verify explicitly (admin verify/scan); recovery itself
-        # skips the device pass so cheap reads stay cheap
-        stores, report = recover_stores(wal, verify_on_device=False)
+        # skips BOTH device passes — verification and the batched device
+        # rebuild — so cheap reads (`domain list`) never pay JAX backend
+        # init plus a whole-cluster device replay
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
     else:
         stores, report = open_durable_stores(wal), None
     # the wall clock, not the test clock: retention, cron, and timeouts

@@ -196,6 +196,12 @@ class RecoveryReport:
     device_verified: int = 0
     oracle_fallback: int = 0
     divergent: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: open runs whose history was never referenced by any current-run
+    #: record — orphan tails of starts that crashed before the
+    #: create_workflow commit point, or NDC zombies. Their state is kept
+    #: (rebuildable, harmless) but they are not counted open, get no
+    #: visibility records, and the task refresher never dispatches them.
+    quarantined: List[Tuple[str, str, str]] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -210,7 +216,8 @@ def open_durable_stores(path: str) -> Stores:
 
 
 def recover_stores(path: str, verify_on_device: bool = True,
-                   layout=None) -> Tuple[Stores, RecoveryReport]:
+                   layout=None, rebuild_on_device: bool = True
+                   ) -> Tuple[Stores, RecoveryReport]:
     """Rebuild a cluster's stores from its write-ahead log.
 
     1. replay the log: domains, shard infos, history branches (appends +
@@ -225,6 +232,10 @@ def recover_stores(path: str, verify_on_device: bool = True,
     """
     stores = Stores()
     stores.recovered_config = []
+    #: every run a current-run record EVER referenced (not just the final
+    #: pointer): a run with history but no reference is an orphan tail of
+    #: a start that died before its create_workflow commit point
+    referenced_runs = set()
     for rec in DurableLog.read_all(path):
         t = rec["t"]
         if t == "d":
@@ -264,6 +275,7 @@ def recover_stores(path: str, verify_on_device: bool = True,
             stores.recovered_config.append(
                 (rec["k"], rec["v"], rec.get("dom")))
         elif t == "cur":
+            referenced_runs.add((rec["d"], rec["w"], rec["r"]))
             stores.execution.restore_current(
                 rec["d"], rec["w"],
                 CurrentExecution(run_id=rec["r"], state=rec["st"],
@@ -277,7 +289,8 @@ def recover_stores(path: str, verify_on_device: bool = True,
                     task=_repl_task_from(rec["p"]["task"]),
                     error=rec["p"]["err"]))
 
-    report = _rebuild_executions(stores, verify_on_device, layout)
+    report = _rebuild_executions(stores, verify_on_device, layout,
+                                 referenced_runs, rebuild_on_device)
     _reconcile_current_pointers(stores)
     # new writes continue the same log (records are idempotent to replay:
     # recovery takes the last pointer values and appends are per-branch
@@ -310,7 +323,8 @@ def _reconcile_current_pointers(stores: Stores) -> None:
 
 
 def _rebuild_executions(stores: Stores, verify_on_device: bool,
-                        layout=None) -> RecoveryReport:
+                        layout=None, referenced_runs=frozenset(),
+                        rebuild_on_device: bool = True) -> RecoveryReport:
     from ..core.enums import WorkflowState
     from ..oracle.mutable_state import DomainEntry
     from .rebuild import DeviceRebuilder
@@ -338,7 +352,7 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
     from ..core.checksum import DEFAULT_LAYOUT
     layout = layout if layout is not None else DEFAULT_LAYOUT
     rebuilder = DeviceRebuilder(layout)
-    states = rebuilder.rebuild(jobs) if jobs else []
+    states = rebuilder.rebuild(jobs, on_device=rebuild_on_device) if jobs else []
     report.device_rebuilt = rebuilder.stats.device
     report.rebuild_fallback = rebuilder.stats.oracle_fallback
 
@@ -359,15 +373,6 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
             ms.version_histories.current_index = current_branch
         stores.execution.upsert_workflow(ms, set_current=False)
         report.executions_rebuilt += 1
-        if ms.execution_info.state != WorkflowState.Completed:
-            report.open_workflows += 1
-        # visibility is DERIVED data (the reference reindexes ES from
-        # history); rebuild the records here instead of logging them.
-        # Only runs holding the current pointer (or closed runs) get
-        # records: zombies and orphan history from failed starts must not
-        # surface as phantom open workflows. Close time approximates to
-        # the completion event's timestamp.
-        from .persistence import VisibilityRecord
         info = ms.execution_info
         try:
             is_current = (stores.execution.get_current_run_id(
@@ -375,6 +380,23 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
         except Exception:
             is_current = False
         closed = info.state == WorkflowState.Completed
+        if not closed:
+            # an open run never referenced by ANY current-run record is an
+            # orphan tail of a start that died before its create_workflow
+            # commit point (or an NDC zombie): keep the snapshot but never
+            # surface it as open — the reference treats such history as
+            # garbage nodes, not a live execution
+            if not is_current and key not in referenced_runs:
+                report.quarantined.append(key)
+            else:
+                report.open_workflows += 1
+        # visibility is DERIVED data (the reference reindexes ES from
+        # history); rebuild the records here instead of logging them.
+        # Only runs holding the current pointer (or closed runs) get
+        # records: zombies and orphan history from failed starts must not
+        # surface as phantom open workflows. Close time approximates to
+        # the completion event's timestamp.
+        from .persistence import VisibilityRecord
         if is_current or closed:
             stores.visibility.record_started(VisibilityRecord(
                 domain_id=key[0], workflow_id=key[1], run_id=key[2],

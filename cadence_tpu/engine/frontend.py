@@ -208,7 +208,9 @@ class Frontend:
                 task.domain_id, task.workflow_id, task.run_id,
                 task.schedule_id, request_id=str(uuid.uuid4()))
         except (InvalidRequestError, EntityNotExistsError):
-            # stale task (decision handled / run never committed) — drop
+            # stale task (decision handled / run never committed) — ack it
+            # away so its persisted row doesn't pin the task-list GC level
+            self.matching.complete_task(task, TASK_LIST_TYPE_DECISION)
             return None
         except Exception:
             # transient engine/store failure: the consumed task must not be
@@ -216,6 +218,8 @@ class Frontend:
             # successful RecordDecisionTaskStarted)
             self.matching.requeue_task(task, TASK_LIST_TYPE_DECISION)
             raise
+        # successful engine write: second phase of the ack deletes the row
+        self.matching.complete_task(task, TASK_LIST_TYPE_DECISION)
         ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
                                       task.run_id)
         history = engine.get_history(task.domain_id, task.workflow_id,
@@ -333,10 +337,13 @@ class Frontend:
                 task.domain_id, task.workflow_id, task.run_id,
                 task.schedule_id, request_id=str(uuid.uuid4()))
         except (InvalidRequestError, EntityNotExistsError):
-            return None  # stale (timed out / closed / never committed)
+            # stale (timed out / closed / never committed): ack it away
+            self.matching.complete_task(task, TASK_LIST_TYPE_ACTIVITY)
+            return None
         except Exception:
             self.matching.requeue_task(task, TASK_LIST_TYPE_ACTIVITY)
             raise
+        self.matching.complete_task(task, TASK_LIST_TYPE_ACTIVITY)
         ms = engine.get_mutable_state(task.domain_id, task.workflow_id,
                                       task.run_id)
         ai = ms.pending_activity_info_ids.get(task.schedule_id)

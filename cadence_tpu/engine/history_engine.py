@@ -1455,13 +1455,13 @@ class _Txn:
         # (shard/context.go:586-700 range-ID fence). A failure before the
         # update leaves only harmless garbage: an orphan history tail that
         # the next append OVERWRITES (append_batch's node-overwrite
-        # semantics) and stale tasks the executors' guards drop.
-        self.engine.shard.append_history(
-            info.domain_id, info.workflow_id, info.run_id, self.events)
-        self.engine.shard.insert_tasks(
-            info.domain_id, info.workflow_id, info.run_id,
+        # semantics) and stale tasks the executors' guards drop. The shard
+        # holds its lock across the compound op and prechecks the state
+        # CAS, so a concurrent writer of the same workflow fails before
+        # it can clobber this transaction's committed tail.
+        self.engine.shard.commit_workflow(
+            self.ms, expected_next_event_id, self.events,
             new_transfer, new_timer)
-        self.engine.shard.update_workflow(self.ms, expected_next_event_id)
         self.engine._publish_replication(info.domain_id, info.workflow_id,
                                          info.run_id, self.events, self.ms)
         # wake history long-polls (events/notifier.go NotifyNewHistoryEvent)

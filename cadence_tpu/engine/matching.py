@@ -49,6 +49,11 @@ class MatchedTask:
     #: task rides the decision task list without any history mutation,
     #: matchingEngine QueryWorkflow passthrough)
     query_id: str = ""
+    #: persisted-task identity for the two-phase ack: the store row is
+    #: deleted only after the engine write behind the delivery succeeds
+    #: (complete_task); 0/"" = sync-matched, nothing persisted to ack
+    task_id: int = 0
+    source: str = ""
 
 
 class ParkedPoll:
@@ -100,12 +105,24 @@ class _TaskListManager:
             domain_id, name, task_type)
         self._lock = threading.Lock()
         self._buffer: Deque[PersistedTask] = deque()
+        # taskReader (service/matching/taskReader.go): a fresh lessee pumps
+        # the store's surviving rows back into its dispatch buffer — this
+        # is what makes the two-phase ack real: a task popped but never
+        # acked before the previous owner died redelivers from here
+        self._buffer.extend(stores.task.get_tasks(
+            domain_id, name, task_type, min_task_id=0, batch_size=10**9))
         #: query-only tasks: transient, never persisted (a lost query is
         #: retried by the caller; the reference's query tasks are sync-only)
         self._query_buffer: Deque[tuple] = deque()
         self._parked: Deque[ParkedPoll] = deque()
         self._next_task_id = self._info.range_id * 100000
         self._ack = 0
+        #: popped-but-unacked persisted tasks (two-phase ack: the store row
+        #: outlives delivery until the engine write succeeds, so a crash
+        #: between pop and handoff cannot lose the task — the reference
+        #: taskGC only deletes below the ack level, taskListManager.go)
+        self._inflight: Dict[int, PersistedTask] = {}
+        self._max_popped = 0
 
     def _sync_match_locked(self, matched: MatchedTask) -> bool:
         while self._parked:
@@ -138,13 +155,15 @@ class _TaskListManager:
                     schedule_id=-1, task_list=base, query_id=q[3]))
                 return
             task = self._pop_locked()
+            src = self._info.name
             if task is None and fallback is not None:
                 task = fallback.poll()
+                src = fallback._info.name
             if task is not None:
                 poll._try_deliver(MatchedTask(
                     domain_id=task.domain_id, workflow_id=task.workflow_id,
                     run_id=task.run_id, schedule_id=task.schedule_id,
-                    task_list=base))
+                    task_list=base, task_id=task.task_id, source=src))
                 return
             self._parked.append(poll)
             poll._unpark = lambda: self._remove_parked(poll)
@@ -184,17 +203,34 @@ class _TaskListManager:
         if not self._buffer:
             return None
         task = self._buffer.popleft()
-        self._ack = max(self._ack, task.task_id)
-        try:
-            # completed-task GC is BEST-EFFORT (taskGC batches deletions
-            # and tolerates failures): a failed ack must never lose the
-            # popped task — the rows get re-deleted by a later ack
-            self._stores.task.complete_tasks_less_than(
-                self._info.domain_id, self._info.name, self._info.task_type,
-                self._ack)
-        except Exception:
-            pass
+        if task.task_id:
+            # two-phase: the persisted row stays until complete() — a crash
+            # between pop and engine write redelivers from the store
+            self._inflight[task.task_id] = task
+            self._max_popped = max(self._max_popped, task.task_id)
         return task
+
+    def complete(self, task_id: int) -> None:
+        """Ack a delivered task: delete persisted rows below the lowest
+        still-outstanding id (taskGC semantics — GC is best-effort and
+        batched; a failed delete retries on the next ack)."""
+        if not task_id:
+            return
+        with self._lock:
+            self._inflight.pop(task_id, None)
+            outstanding = [t.task_id for t in self._buffer if t.task_id]
+            outstanding.extend(self._inflight)
+            # the store deletes ids <= level, so the GC level sits just
+            # below the lowest still-outstanding id
+            level = min(outstanding) - 1 if outstanding else self._max_popped
+            if level > self._ack:
+                self._ack = level
+                try:
+                    self._stores.task.complete_tasks_less_than(
+                        self._info.domain_id, self._info.name,
+                        self._info.task_type, self._ack)
+                except Exception:
+                    pass
 
     def poll(self) -> Optional[PersistedTask]:
         with self._lock:
@@ -202,8 +238,13 @@ class _TaskListManager:
 
     def requeue_front(self, task: PersistedTask) -> None:
         """Return a polled-but-undeliverable task to the head of the
-        backlog (the sibling-sweep race loser)."""
+        backlog (the sibling-sweep race loser / failed engine write);
+        leaves the in-flight ledger — the task is queued again, not done.
+        The persisted row was never deleted (two-phase ack), so the
+        requeue is store-visible: a new lessee would also re-read it."""
         with self._lock:
+            if task.task_id:
+                self._inflight.pop(task.task_id, None)
             self._buffer.appendleft(task)
 
     def add_query(self, domain_id: str, workflow_id: str, run_id: str,
@@ -302,28 +343,32 @@ class MatchingEngine:
     # -- polls (called by workers via frontend) ----------------------------
 
     def _poll_task(self, domain_id: str, base: str, task_type: int
-                   ) -> Optional[PersistedTask]:
+                   ) -> Optional[Tuple[PersistedTask, str]]:
         """Pick a partition round-robin; an empty non-root partition
         forwards the poll to the root's backlog (ForwardPoll). As a last
         resort, sweep every EXISTING partition manager of this base — so
         tasks persisted on partitions beyond a lowered partition-count
-        knob still drain instead of stranding."""
+        knob still drain instead of stranding. Returns (task, source
+        partition name) so the caller can ack the right backlog."""
         p = self._next_partition(self._poll_rr, domain_id, base, task_type)
-        task = self._manager(domain_id, partition_name(base, p),
-                             task_type).poll()
+        src = partition_name(base, p)
+        task = self._manager(domain_id, src, task_type).poll()
         if task is None and p != 0:
+            src = base
             task = self._manager(domain_id, base, task_type).poll()
         if task is None:
             prefix = f"{PARTITION_PREFIX}{base}/"
             with self._lock:
-                candidates = [mgr for (d, name, t), mgr in self._managers.items()
+                candidates = [(name, mgr)
+                              for (d, name, t), mgr in self._managers.items()
                               if d == domain_id and t == task_type
                               and (name == base or name.startswith(prefix))]
-            for mgr in candidates:
+            for name, mgr in candidates:
                 task = mgr.poll()
                 if task is not None:
+                    src = name
                     break
-        return task
+        return None if task is None else (task, src)
 
     def _park(self, domain_id: str, task_list: str, task_type: int,
               partition: int) -> ParkedPoll:
@@ -348,18 +393,20 @@ class MatchingEngine:
             # something else meanwhile, put the swept task back.
             prefix = f"{PARTITION_PREFIX}{task_list}/"
             with self._lock:
-                siblings = [m for (d, name, t), m in self._managers.items()
+                siblings = [(name, m)
+                            for (d, name, t), m in self._managers.items()
                             if d == domain_id and t == task_type
                             and (name == task_list or name.startswith(prefix))
                             and m is not mgr]
-            for sib in siblings:
+            for sib_name, sib in siblings:
                 task = sib.poll()
                 if task is None:
                     continue
                 delivered = poll._try_deliver(MatchedTask(
                     domain_id=task.domain_id, workflow_id=task.workflow_id,
                     run_id=task.run_id, schedule_id=task.schedule_id,
-                    task_list=task_list))
+                    task_list=task_list, task_id=task.task_id,
+                    source=sib_name))
                 if delivered and poll._unpark is not None:
                     poll._unpark()
                 else:
@@ -385,31 +432,48 @@ class MatchingEngine:
             return MatchedTask(domain_id=q[0], workflow_id=q[1], run_id=q[2],
                                schedule_id=-1, task_list=task_list,
                                query_id=q[3])
-        task = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_DECISION)
-        if task is None:
+        hit = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_DECISION)
+        if hit is None:
             return None
+        task, src = hit
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
                            run_id=task.run_id, schedule_id=task.schedule_id,
-                           task_list=task_list)
+                           task_list=task_list, task_id=task.task_id,
+                           source=src)
 
     def poll_for_activity_task(self, domain_id: str, task_list: str
                                ) -> Optional[MatchedTask]:
-        task = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY)
-        if task is None:
+        hit = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY)
+        if hit is None:
             return None
+        task, src = hit
         return MatchedTask(domain_id=task.domain_id, workflow_id=task.workflow_id,
                            run_id=task.run_id, schedule_id=task.schedule_id,
-                           task_list=task_list)
+                           task_list=task_list, task_id=task.task_id,
+                           source=src)
 
     def requeue_task(self, task: MatchedTask, task_type: int) -> None:
         """Return a delivered-but-unprocessed task (the engine write behind
-        it failed) to the FRONT of its base task list's root backlog — the
-        reference only acks a matched task after successful delivery, so a
-        failed RecordTaskStarted redelivers."""
-        mgr = self._manager(task.domain_id, task.task_list, task_type)
+        it failed) to the FRONT of its source backlog — the reference only
+        acks a matched task after successful delivery, so a failed
+        RecordTaskStarted redelivers. The original persisted identity is
+        kept: the store row was never deleted (two-phase ack), so the
+        requeue is store-visible, not an in-memory synthetic."""
+        mgr = self._manager(task.domain_id, task.source or task.task_list,
+                            task_type)
         mgr.requeue_front(PersistedTask(
-            task_id=0, domain_id=task.domain_id, workflow_id=task.workflow_id,
-            run_id=task.run_id, schedule_id=task.schedule_id))
+            task_id=task.task_id, domain_id=task.domain_id,
+            workflow_id=task.workflow_id, run_id=task.run_id,
+            schedule_id=task.schedule_id))
+
+    def complete_task(self, task: MatchedTask, task_type: int) -> None:
+        """Second phase of the ack: the engine write behind the delivery
+        succeeded (or the task proved stale) — delete the persisted row.
+        Sync-matched tasks (task_id 0) were never persisted; no-op."""
+        if not task.task_id or not task.source:
+            return
+        self._manager(task.domain_id, task.source, task_type).complete(
+            task.task_id)
 
     def describe_task_list(self, domain_id: str, task_list: str,
                            task_type: int) -> Dict[str, int]:

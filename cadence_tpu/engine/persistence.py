@@ -341,6 +341,26 @@ class ExecutionStore:
                 )
                 self._log_current(cur_key)
 
+    def check_next_event_id(self, domain_id: str, workflow_id: str,
+                            run_id: str, expected: int) -> None:
+        """Read-only precheck of update_workflow's CAS condition. Committing
+        a transaction as events→tasks→state leaves the CAS last, so without
+        this a concurrent loser would overwrite the winner's committed
+        history tail (append_batch overwrite semantics) before failing its
+        own CAS. The reference prevents this with the per-workflow context
+        lock (execution/cache.go:182); here the shard holds its lock across
+        the compound commit and fails the loser before any write."""
+        with self._lock:
+            existing = self._executions.get((domain_id, workflow_id, run_id))
+            if existing is None:
+                raise EntityNotExistsError(
+                    f"no execution {workflow_id}/{run_id}")
+            if existing.execution_info.next_event_id != expected:
+                raise ConditionFailedError(
+                    f"{workflow_id}: next_event_id "
+                    f"{existing.execution_info.next_event_id} != expected "
+                    f"{expected}")
+
     def upsert_workflow(self, ms: MutableState, set_current: bool = True) -> None:
         """UpdateWorkflowExecutionAsPassive analog: unconditional snapshot
         upsert, used by the standby-side replicator (the replicator is the

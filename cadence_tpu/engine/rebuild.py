@@ -75,9 +75,24 @@ class DeviceRebuilder:
         return self.rebuild([(batches, domain_entry)])[0]
 
     def rebuild(self, jobs: Sequence[Tuple[Sequence[HistoryBatch],
-                                           Optional[DomainEntry]]]
-                ) -> List[MutableState]:
-        """Rebuild one MutableState per job (batches, domain_entry)."""
+                                           Optional[DomainEntry]]],
+                on_device: bool = True) -> List[MutableState]:
+        """Rebuild one MutableState per job (batches, domain_entry).
+
+        `on_device=False` skips JAX entirely and replays through the
+        oracle — for read-only CLI invocations where paying backend init
+        plus a whole-cluster device replay to answer `domain list` is
+        wrong (ADVICE r3)."""
+        if not on_device:
+            from ..utils import metrics as m
+            self.stats.oracle_fallback += len(jobs)
+            scope = self.metrics.scope(m.SCOPE_REBUILD)
+            scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
+            done = self.stats.device + self.stats.oracle_fallback
+            self.metrics.gauge(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
+                               (self.stats.oracle_fallback / done)
+                               if done else 0.0)
+            return [self._oracle_rebuild(b, e) for b, e in jobs]
         import jax
         import jax.numpy as jnp
 

@@ -128,6 +128,31 @@ class ShardContext:
                 self._closed = True
                 raise
 
+    def commit_workflow(self, ms: MutableState, expected_next_event_id: int,
+                        events, transfer: List[GeneratedTask],
+                        timer: List[GeneratedTask]) -> None:
+        """Atomic transaction commit: events → tasks → fenced state update
+        under ONE shard lock hold, with the state CAS prechecked first.
+
+        The reference write order (execution/context.go:105) appends events
+        before the conditional state update; it is safe there because the
+        per-workflow context lock (execution/cache.go:182) serializes
+        writers of the same workflow. This engine has no context cache, so
+        the shard lock plays that role — and the precheck makes a
+        concurrent loser fail BEFORE its append can truncate the winner's
+        committed history tail (append_batch node-overwrite semantics)."""
+        info = ms.execution_info
+        with self._lock:
+            self._ensure_open()
+            self._stores.execution.check_next_event_id(
+                info.domain_id, info.workflow_id, info.run_id,
+                expected_next_event_id)
+            self.append_history(info.domain_id, info.workflow_id,
+                                info.run_id, events)
+            self.insert_tasks(info.domain_id, info.workflow_id, info.run_id,
+                              transfer, timer)
+            self.update_workflow(ms, expected_next_event_id)
+
     # -- shard task queues -------------------------------------------------
 
     def insert_tasks(self, domain_id: str, workflow_id: str, run_id: str,

@@ -150,8 +150,16 @@ def gen_step(g: GenState, seed: int, first_index: int, step: int,
 
     pending = (g.act_occ.sum(axis=1) + g.tmr_occ.sum(axis=1)
                + g.ch_occ.sum(axis=1)).astype(I64)
+    # unstarted activities/children need TWO drain events (start, close):
+    # the engine never produces a Completed event for an unstarted item,
+    # so the drain must not either (generator.cc mirrors this)
+    n_unstarted = ((g.act_occ & ~g.act_started).sum(axis=1)
+                   + (g.ch_occ & ~g.ch_started).sum(axis=1)).astype(I64)
     remaining = jnp.int64(total_events - step)
-    drain = remaining <= pending + 2
+    # margin 4: one normal step can grow pending+n_unstarted by 2 (a
+    # schedule/init event creates an occupied AND unstarted item) while
+    # remaining drops 1, overshooting a tighter threshold by 2
+    drain = remaining <= pending + n_unstarted + 4
 
     # -- choose the action code -------------------------------------------
     # normal mode by decision phase
@@ -160,25 +168,30 @@ def gen_step(g: GenState, seed: int, first_index: int, step: int,
     act_free = ~g.act_occ.all(axis=1)
     act_unstarted = (g.act_occ & ~g.act_started).any(axis=1)
     act_any = g.act_occ.any(axis=1)
+    # closes only ever land on STARTED items: the engine cannot produce
+    # ActivityTaskCompleted / ChildWorkflowExecutionCompleted without a
+    # preceding Started event (state_builder.go replicate order)
+    act_started_any = (g.act_occ & g.act_started).any(axis=1)
     tmr_free = ~g.tmr_occ.all(axis=1)
     tmr_any = g.tmr_occ.any(axis=1)
     ch_free = ~g.ch_occ.all(axis=1)
     ch_unstarted = (g.ch_occ & ~g.ch_started).any(axis=1)
     ch_any = g.ch_occ.any(axis=1)
+    ch_started_any = (g.ch_occ & g.ch_started).any(axis=1)
 
     external = jnp.select(
         [die2 <= 1, die2 == 2, die2 == 3, die2 == 4, die2 == 5,
          die2 == 6, die2 == 7],
         [jnp.where(act_free, A_ASCHED, A_SIGNAL),
          jnp.where(act_unstarted, A_ASTART, A_SIGNAL),
-         jnp.where(act_any, A_ACLOSE, A_SIGNAL),
+         jnp.where(act_started_any, A_ACLOSE, A_SIGNAL),
          jnp.where(tmr_free, A_TSTART,
                    jnp.where(tmr_any, A_TFIRE, A_SIGNAL)),
          jnp.where(tmr_any, A_TFIRE, A_SIGNAL),
          jnp.where(ch_free, A_CINIT,
-                   jnp.where(ch_any, A_CCLOSE, A_SIGNAL)),
+                   jnp.where(ch_started_any, A_CCLOSE, A_SIGNAL)),
          jnp.where(ch_unstarted, A_CSTART,
-                   jnp.where(ch_any, A_CCLOSE, A_SIGNAL))],
+                   jnp.where(ch_started_any, A_CCLOSE, A_SIGNAL))],
         A_SIGNAL)
     normal = jnp.select(
         [g.phase == 1, g.phase == 2],
@@ -186,9 +199,13 @@ def gen_step(g: GenState, seed: int, first_index: int, step: int,
          jnp.where(die < 6, A_DCOMPLETE, external)],
         jnp.where(die < 8, A_DSCHED, external))
 
+    # start-before-close within each family: closes pick the FIRST occupied
+    # slot, and all starts precede all closes, so a close never lands on an
+    # unstarted item — the history shape the real engine produces
     drained = jnp.select(
-        [act_any, tmr_any, ch_any, remaining > 1],
-        [A_ACLOSE, A_TFIRE, A_CCLOSE, A_SIGNAL],
+        [act_unstarted, act_any, ch_unstarted, tmr_any, ch_any,
+         remaining > 1],
+        [A_ASTART, A_ACLOSE, A_CSTART, A_TFIRE, A_CCLOSE, A_SIGNAL],
         A_WFCLOSE)
 
     code = jnp.where(drain, drained, normal)
@@ -235,7 +252,7 @@ def gen_step(g: GenState, seed: int, first_index: int, step: int,
                      jnp.where(sel, act_sched, 0).sum(axis=1), a[0])
     act_started = act_started | sel
 
-    sel, _ = _first(act_occ)
+    sel, _ = _first(act_occ & act_started)
     sel = sel & m(A_ACLOSE)[:, None]
     a[0] = jnp.where(m(A_ACLOSE),
                      jnp.where(sel, act_sched, 0).sum(axis=1), a[0])
@@ -270,7 +287,7 @@ def gen_step(g: GenState, seed: int, first_index: int, step: int,
                      jnp.where(sel, ch_init, 0).sum(axis=1), a[0])
     ch_started = ch_started | sel
 
-    sel, _ = _first(ch_occ)
+    sel, _ = _first(ch_occ & ch_started)
     sel = sel & m(A_CCLOSE)[:, None]
     a[0] = jnp.where(m(A_CCLOSE),
                      jnp.where(sel, ch_init, 0).sum(axis=1), a[0])

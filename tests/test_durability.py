@@ -245,3 +245,37 @@ class TestNDCDurability:
         standby_ms = c2.standby.stores.execution.get_workflow(
             domain_id, "rq", run_id)
         assert standby_ms.execution_info.close_status == CloseStatus.Completed
+
+
+class TestOrphanQuarantine:
+    def test_orphan_history_not_resurrected_as_open(self, tmp_path):
+        """History appended by a start that died before its
+        create_workflow commit point must not come back as an open
+        workflow after recovery (ADVICE r3): it is quarantined — state
+        kept, but excluded from open counts, visibility, and dispatch."""
+        from cadence_tpu.gen.corpus import generate_corpus
+
+        wal = str(tmp_path / "wal.jsonl")
+        box = Onebox(num_hosts=1, num_shards=4,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        # a real workflow, completed normally
+        box.frontend.start_workflow_execution(DOMAIN, "wf-live", "echo", TL)
+        TaskPoller(box, DOMAIN, TL, {"wf-live": EchoDecider(TL)}).drain()
+        # forge a torn start: history lands in the WAL, but the process
+        # dies before create_workflow ever writes a current-run record
+        orphan = generate_corpus("basic", num_workflows=1, seed=3,
+                                 target_events=20)[0]
+        # only the start batch: the run is still OPEN when the crash hits
+        box.stores.history.append_batch(domain_id, "wf-orphan",
+                                        "orphan-run", orphan[0].events)
+        # crash + recover
+        stores, report = recover_stores(wal)
+        assert (domain_id, "wf-orphan", "orphan-run") in report.quarantined
+        assert report.open_workflows == 0
+        open_wfs = stores.visibility.list_open(domain_id)
+        assert [r.workflow_id for r in open_wfs] == []
+        # the real workflow is still there and closed
+        closed = stores.visibility.list_closed(domain_id)
+        assert "wf-live" in [r.workflow_id for r in closed]

@@ -136,3 +136,78 @@ class TestPartitionsAndForwarder:
         desc = box.matching.describe_task_list(domain_id, TL, 0)
         assert desc["backlog"] == 3
         assert desc["partitions"] == 3
+
+
+class TestTwoPhaseAck:
+    """The persisted task row must outlive delivery until the engine write
+    behind it succeeds (taskListManager ack levels + taskGC: the reference
+    only GCs below the ack level, so a crash between poll and handoff
+    redelivers from the store — ADVICE r3)."""
+
+    def _stores_engine(self):
+        from cadence_tpu.engine.matching import MatchingEngine
+        from cadence_tpu.engine.persistence import Stores
+        stores = Stores()
+        return stores, MatchingEngine(stores)
+
+    def test_row_survives_poll_until_complete(self):
+        from cadence_tpu.engine.matching import TASK_LIST_TYPE_DECISION
+        stores, eng = self._stores_engine()
+        eng.add_decision_task("d", TL, "wf", "run", 2)
+        task = eng.poll_for_decision_task("d", TL)
+        assert task is not None and task.task_id and task.source == TL
+        # popped but NOT acked: the store row must still exist
+        assert len(stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0)) == 1
+        eng.complete_task(task, TASK_LIST_TYPE_DECISION)
+        assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []
+
+    def test_requeue_preserves_persisted_identity(self):
+        from cadence_tpu.engine.matching import TASK_LIST_TYPE_DECISION
+        stores, eng = self._stores_engine()
+        eng.add_decision_task("d", TL, "wf", "run", 2)
+        task = eng.poll_for_decision_task("d", TL)
+        eng.requeue_task(task, TASK_LIST_TYPE_DECISION)
+        again = eng.poll_for_decision_task("d", TL)
+        # the SAME persisted task comes back (not a task_id=0 synthetic)
+        assert again.task_id == task.task_id and again.source == task.source
+        assert len(stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0)) == 1
+        eng.complete_task(again, TASK_LIST_TYPE_DECISION)
+        assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []
+
+    def test_out_of_order_completion_gc_floor(self):
+        """Completing a later task must not GC an earlier, still-inflight
+        one; the floor advances only past the lowest outstanding id."""
+        from cadence_tpu.engine.matching import TASK_LIST_TYPE_DECISION
+        stores, eng = self._stores_engine()
+        for i in range(3):
+            eng.add_decision_task("d", TL, f"wf-{i}", "run", 2)
+        t1 = eng.poll_for_decision_task("d", TL)
+        t2 = eng.poll_for_decision_task("d", TL)
+        t3 = eng.poll_for_decision_task("d", TL)
+        eng.complete_task(t2, TASK_LIST_TYPE_DECISION)
+        eng.complete_task(t3, TASK_LIST_TYPE_DECISION)
+        remaining = stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0)
+        assert t1.task_id in {t.task_id for t in remaining}
+        eng.complete_task(t1, TASK_LIST_TYPE_DECISION)
+        assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []
+
+    def test_new_lessee_redelivers_unacked_tasks_from_store(self):
+        """A task popped but never acked before its owner died comes back
+        from the store when a fresh lessee's taskReader pumps surviving
+        rows (taskReader.go) — the crash-redelivery half of the two-phase
+        ack."""
+        from cadence_tpu.engine.matching import (
+            TASK_LIST_TYPE_DECISION,
+            MatchingEngine,
+        )
+        stores, eng = self._stores_engine()
+        eng.add_decision_task("d", TL, "wf", "run", 2)
+        task = eng.poll_for_decision_task("d", TL)
+        assert task is not None
+        # owner dies between pop and ack; a new engine leases over the
+        # same persistence and must see the task again
+        eng2 = MatchingEngine(stores)
+        again = eng2.poll_for_decision_task("d", TL)
+        assert again is not None and again.task_id == task.task_id
+        eng2.complete_task(again, TASK_LIST_TYPE_DECISION)
+        assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []

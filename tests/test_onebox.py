@@ -172,6 +172,43 @@ class TestClusterMechanics:
                 box.stores.domain.by_name(DOMAIN).domain_id, "wf-fence", "s")
 
 
+    def test_concurrent_txn_loser_fails_before_clobbering_history(self, box):
+        """Two transactions race on one workflow: the loser's commit must
+        fail BEFORE its history append can truncate the winner's committed
+        tail (shard.commit_workflow precheck; the reference serializes via
+        the per-workflow context lock, execution/cache.go:182)."""
+        import copy
+
+        import pytest as _pytest
+
+        from cadence_tpu.core.enums import EventType
+        from cadence_tpu.core.events import HistoryEvent
+        from cadence_tpu.engine.persistence import ConditionFailedError
+        box.frontend.start_workflow_execution(DOMAIN, "wf-race", "echo", TL)
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(domain_id, "wf-race")
+        engine = box.route("wf-race")
+        # T2 loads its snapshot first (stale after T1 commits)
+        stale = copy.deepcopy(box.stores.execution.get_workflow(
+            domain_id, "wf-race", run_id))
+        expected = stale.execution_info.next_event_id
+        # T1 wins: a real signal through the engine
+        engine.signal_workflow(domain_id, "wf-race", "winner")
+        # T2 tries to commit at the same event id
+        ev = HistoryEvent(id=expected,
+                          event_type=EventType.WorkflowExecutionSignaled,
+                          attrs={"signal_name": "loser"})
+        with _pytest.raises(ConditionFailedError):
+            engine.shard.commit_workflow(stale, expected, [ev], [], [])
+        # the winner's tail is intact — no silent history/state divergence
+        events = box.stores.history.read_events(domain_id, "wf-race", run_id)
+        signals = [e for e in events
+                   if e.event_type == EventType.WorkflowExecutionSignaled]
+        assert [e.get("signal_name") for e in signals] == ["winner"]
+        stored = box.stores.execution.get_workflow(domain_id, "wf-race", run_id)
+        assert stored.execution_info.next_event_id == events[-1].id + 1
+
+
 class TestNorthStarLoop:
     def test_device_replay_matches_live_state(self, box):
         """Run a mixed fleet to completion, then device-replay every
