@@ -45,7 +45,12 @@ class ShardController:
         return shard_id_for_workflow(workflow_id, self.num_shards)
 
     def engine_for_shard(self, shard_id: int) -> HistoryEngine:
-        """GetEngineForShard (controller.go:199-211): create+acquire lazily."""
+        """GetEngineForShard (controller.go:199-211): create+acquire lazily.
+
+        A cached engine whose shard context was FENCED (another owner bumped
+        the range while this host was partitioned/paused) is evicted and
+        re-acquired — a restored host must not serve a deposed context
+        forever (controller.go shardClosedCallback:258)."""
         if not self._owns(shard_id):
             raise ShardNotOwnedError(
                 f"host {self.host} does not own shard {shard_id} "
@@ -53,12 +58,22 @@ class ShardController:
             )
         with self._lock:
             engine = self._engines.get(shard_id)
+            if engine is not None and engine.shard.is_closed:
+                del self._engines[shard_id]
+                engine = None
             if engine is None:
                 ctx = ShardContext(shard_id, self.host, self.stores)
                 ctx.acquire()
                 engine = self._factory(ctx)
                 self._engines[shard_id] = engine
             return engine
+
+    def cached_engine(self, shard_id: int) -> Optional[HistoryEngine]:
+        """The engine object currently cached for a shard, WITHOUT ring
+        validation or acquisition — admin/introspection only (the
+        deposed-owner fencing probe and DescribeHistoryHost analog)."""
+        with self._lock:
+            return self._engines.get(shard_id)
 
     def engine_for_workflow(self, workflow_id: str) -> HistoryEngine:
         return self.engine_for_shard(self.shard_for(workflow_id))
@@ -82,8 +97,18 @@ class ShardController:
                 if not self._owns(shard_id):
                     self._engines[shard_id].shard.close()
                     del self._engines[shard_id]
+        self.ensure_assigned()
+
+    def ensure_assigned(self) -> None:
+        """Idempotent eager acquisition of every assigned shard. Per-shard
+        failures (store briefly unreachable, ring moved mid-loop) skip that
+        shard — the next call, routed request, or queue pump retries; one
+        bad shard must never abort acquisition of the rest."""
         for shard_id in self.assigned_shards():
-            self.engine_for_shard(shard_id)
+            try:
+                self.engine_for_shard(shard_id)
+            except Exception:
+                continue
 
 
 class ShardNotOwnedError(Exception):

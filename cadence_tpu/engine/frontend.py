@@ -180,16 +180,18 @@ class Frontend:
         the poll for sync-match instead of returning immediately (the
         reference's long-poll transport over taskListManager's matcher)."""
         domain_id = self.stores.domain.by_name(domain).domain_id
-        task = self.matching.poll_for_decision_task(domain_id, task_list)
-        if task is None and wait_seconds > 0:
-            parked = self.matching.park_for_decision_task(domain_id, task_list)
-            parked.done.wait(wait_seconds)
-            if parked.task is None:
-                parked.cancel()
-            task = parked.task
+        task = self.matching.poll_and_wait_decision(domain_id, task_list,
+                                                    wait_seconds)
         if task is None:
             return None
-        engine = self.router(task.workflow_id)
+        try:
+            engine = self.router(task.workflow_id)
+        except Exception:
+            # routing failed after the two-phase pop (shard mid-rebalance):
+            # the task must not strand in the in-flight ledger, or it pins
+            # the task-list GC level forever
+            self.matching.requeue_task(task, TASK_LIST_TYPE_DECISION)
+            raise
         key = (task.domain_id, task.workflow_id, task.run_id)
         if task.query_id:
             # query-only task: no history mutation, no decision token;
@@ -320,16 +322,15 @@ class Frontend:
                                wait_seconds: float = 0
                                ) -> Optional[PollActivityResponse]:
         domain_id = self.stores.domain.by_name(domain).domain_id
-        task = self.matching.poll_for_activity_task(domain_id, task_list)
-        if task is None and wait_seconds > 0:
-            parked = self.matching.park_for_activity_task(domain_id, task_list)
-            parked.done.wait(wait_seconds)
-            if parked.task is None:
-                parked.cancel()
-            task = parked.task
+        task = self.matching.poll_and_wait_activity(domain_id, task_list,
+                                                    wait_seconds)
         if task is None:
             return None
-        engine = self.router(task.workflow_id)
+        try:
+            engine = self.router(task.workflow_id)
+        except Exception:
+            self.matching.requeue_task(task, TASK_LIST_TYPE_ACTIVITY)
+            raise
         from .history_engine import InvalidRequestError
         from .persistence import EntityNotExistsError
         try:

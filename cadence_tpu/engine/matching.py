@@ -218,8 +218,14 @@ class _TaskListManager:
             return
         with self._lock:
             self._inflight.pop(task_id, None)
-            outstanding = [t.task_id for t in self._buffer if t.task_id]
-            outstanding.extend(self._inflight)
+            # buffer ids are ascending left-to-right (appends allocate
+            # monotonically; requeue_front returns an earlier — smaller —
+            # id to the head), so the first persisted entry IS the buffer
+            # minimum: O(1) per ack instead of rescanning the backlog
+            buf_min = next((t.task_id for t in self._buffer if t.task_id),
+                           None)
+            inf_min = min(self._inflight) if self._inflight else None
+            outstanding = [x for x in (buf_min, inf_min) if x is not None]
             # the store deletes ids <= level, so the GC level sits just
             # below the lowest still-outstanding id
             level = min(outstanding) - 1 if outstanding else self._max_popped
@@ -451,6 +457,33 @@ class MatchingEngine:
                            run_id=task.run_id, schedule_id=task.schedule_id,
                            task_list=task_list, task_id=task.task_id,
                            source=src)
+
+    def poll_and_wait_decision(self, domain_id: str, task_list: str,
+                               wait_seconds: float = 0
+                               ) -> Optional[MatchedTask]:
+        """Poll; on empty, park for sync-match up to `wait_seconds` (the
+        long-poll composite — also the shape a long poll takes over the
+        wire: the server blocks, no ParkedPoll object crosses processes)."""
+        task = self.poll_for_decision_task(domain_id, task_list)
+        if task is None and wait_seconds > 0:
+            parked = self.park_for_decision_task(domain_id, task_list)
+            parked.done.wait(wait_seconds)
+            if parked.task is None:
+                parked.cancel()
+            task = parked.task
+        return task
+
+    def poll_and_wait_activity(self, domain_id: str, task_list: str,
+                               wait_seconds: float = 0
+                               ) -> Optional[MatchedTask]:
+        task = self.poll_for_activity_task(domain_id, task_list)
+        if task is None and wait_seconds > 0:
+            parked = self.park_for_activity_task(domain_id, task_list)
+            parked.done.wait(wait_seconds)
+            if parked.task is None:
+                parked.cancel()
+            task = parked.task
+        return task
 
     def requeue_task(self, task: MatchedTask, task_type: int) -> None:
         """Return a delivered-but-unprocessed task (the engine write behind

@@ -65,6 +65,13 @@ class ShardContext:
             self._closed = True
 
     @property
+    def is_closed(self) -> bool:
+        """True once this context was deposed (fenced) or released — the
+        controller evicts and re-acquires such contexts."""
+        with self._lock:
+            return self._closed
+
+    @property
     def range_id(self) -> int:
         with self._lock:
             self._ensure_open()

@@ -16,11 +16,11 @@
 //
 // Build: native/build.py (g++ -O3 -shared); loaded via ctypes.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
 #include <thread>
-#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -126,19 +126,54 @@ struct Cursor {
   }
 };
 
-// one workflow's history -> rows [E, L]; returns events packed or -1
+// Per-workflow string interner ("<kind>:<id>" -> dense key from 1) as a
+// flat vector with first-use order: histories hold dozens of distinct IDs
+// at most, so a length-first linear scan beats unordered_map's hashing +
+// temporary-string construction on the per-event hot path.
+struct Interner {
+  struct Entry {
+    uint8_t kind;
+    const char* data;  // points into the wire blob (outlives the pack)
+    uint16_t len;
+  };
+  std::vector<Entry> entries;
+
+  int64_t key(uint8_t kind, const char* data, uint16_t len) {
+    for (size_t i = 0; i < entries.size(); ++i) {
+      const Entry& e = entries[i];
+      if (e.kind == kind && e.len == len &&
+          std::memcmp(e.data, data, len) == 0) {
+        return static_cast<int64_t>(i) + 1;
+      }
+    }
+    entries.push_back(Entry{kind, data, len});
+    return static_cast<int64_t>(entries.size());
+  }
+};
+
+// wire32 extra lanes (ops/encode.py NUM_LANES32 schema): the two 64-bit
+// values (timestamp nanos, Started-event expiration nanos in attr lane 4)
+// ship split lo/hi; everything else must fit int32
+constexpr int kLane32TsHi = 18;
+constexpr int kLane32A4Hi = 19;
+
+template <typename OutT, bool kWire32>
+inline bool WriteLane(OutT* r, int lane, int64_t v) {
+  if (kWire32) {
+    if (v < INT32_MIN || v > INT32_MAX) return false;
+  }
+  r[lane] = static_cast<OutT>(v);
+  return true;
+}
+
+// one workflow's history -> rows [E, L]; returns events packed or -errcode
+template <typename OutT, bool kWire32>
 int64_t PackOne(const uint8_t* blob, int64_t size, int64_t max_events,
-                int64_t L, int64_t* out) {
+                int64_t L, OutT* out) {
   Cursor c{blob, blob + size};
-  // per-workflow interner: "<kind>:<id>" -> dense key from 1
-  std::unordered_map<std::string, int64_t> intern;
-  auto intern_key = [&intern](const char* kind, const std::string& s) {
-    std::string k = std::string(kind) + ":" + s;
-    auto it = intern.find(k);
-    if (it != intern.end()) return it->second;
-    int64_t v = static_cast<int64_t>(intern.size()) + 1;
-    intern.emplace(std::move(k), v);
-    return v;
+  Interner intern;
+  auto intern_key = [&intern](uint8_t kind, const char* data, uint16_t len) {
+    return intern.key(kind, data, len);
   };
 
   int64_t row = 0;
@@ -155,41 +190,84 @@ int64_t PackOne(const uint8_t* blob, int64_t size, int64_t max_events,
       uint8_t n_attrs = c.read<uint8_t>();
       if (i == 0) batch_first = id;
 
-      int64_t attrs[kMaxAttrCode] = {0};
-      bool present[kMaxAttrCode] = {false};
+      int64_t attrs[kMaxAttrCode];
+      bool present[kMaxAttrCode];
+      // each wire attr code appears at most once per event, so kMaxAttrCode
+      // bounds the list (a loaded child-workflow Started event carries 20)
+      uint8_t seen[kMaxAttrCode];
+      int n_seen = 0;
       for (uint8_t a = 0; a < n_attrs && c.ok; ++a) {
         uint8_t code = c.read<uint8_t>();
+        if (code >= kMaxAttrCode) return -2;  // unknown attr: refuse
+        if (n_seen >= kMaxAttrCode) return -2;  // duplicate codes: malformed
+        attrs[code] = 0;
+        present[code] = true;
+        seen[n_seen++] = code;
         if (IsStringCode(code)) {
           uint16_t len = c.read<uint16_t>();
           if (c.p + len > c.end) { c.ok = false; break; }
           if (code == kAActivityId || code == kATimerId) {
-            std::string s(reinterpret_cast<const char*>(c.p), len);
-            attrs[code] = intern_key(code == kAActivityId ? "act" : "timer", s);
+            attrs[code] = intern_key(code,
+                                     reinterpret_cast<const char*>(c.p), len);
           }
           // parent-linkage strings don't become lanes; presence suffices
           c.p += len;
-        } else if (code < kMaxAttrCode) {
-          attrs[code] = c.read<int64_t>();
         } else {
-          return -2;  // unknown attr code: refuse, never skip silently
+          attrs[code] = c.read<int64_t>();
         }
-        if (code < kMaxAttrCode) present[code] = true;
       }
       if (!c.ok) return -1;
       if (row >= max_events) return -3;  // history longer than E
+      // lazily ensure unwritten codes read as 0/absent: clear only what
+      // the per-type switch can touch (cheaper than zeroing 42 slots/event)
+      auto miss = [&](uint8_t code) {
+        if (!std::count(seen, seen + n_seen, code)) {
+          attrs[code] = 0;
+          present[code] = false;
+        }
+      };
+      for (uint8_t code : {static_cast<uint8_t>(kAExecTimeout),
+                           static_cast<uint8_t>(kATaskTimeout),
+                           static_cast<uint8_t>(kABackoff),
+                           static_cast<uint8_t>(kAAttempt),
+                           static_cast<uint8_t>(kAExpirationTs),
+                           static_cast<uint8_t>(kAHasRetry),
+                           static_cast<uint8_t>(kAInitiator),
+                           static_cast<uint8_t>(kASchedEventId),
+                           static_cast<uint8_t>(kAStartedEventId),
+                           static_cast<uint8_t>(kATimeoutType),
+                           static_cast<uint8_t>(kAActivityId),
+                           static_cast<uint8_t>(kAS2S),
+                           static_cast<uint8_t>(kAS2C),
+                           static_cast<uint8_t>(kASTC),
+                           static_cast<uint8_t>(kAHeartbeat),
+                           static_cast<uint8_t>(kARetryExpiration),
+                           static_cast<uint8_t>(kATimerId),
+                           static_cast<uint8_t>(kAStartToFire),
+                           static_cast<uint8_t>(kAInitiatedEventId),
+                           static_cast<uint8_t>(kAParentWorkflowId)})
+        miss(code);
 
-      int64_t* r = out + row * L;
+      OutT* r = out + row * L;
       // real rows are fully written: header lanes below, attr lanes cleared
       // here then filled by the per-type switch (supports buffer reuse)
-      std::memset(r + kLaneA0, 0, sizeof(int64_t) * (L - kLaneA0));
-      r[kLaneEventId] = id;
-      r[kLaneEventType] = type;
-      r[kLaneVersion] = version;
-      r[kLaneTimestamp] = ts;
-      r[kLaneTaskId] = task_id;
-      r[kLaneBatchFirst] = batch_first;
+      std::memset(r + kLaneA0, 0, sizeof(OutT) * (L - kLaneA0));
+      bool fit = true;
+      fit &= WriteLane<OutT, kWire32>(r, kLaneEventId, id);
+      r[kLaneEventType] = static_cast<OutT>(type);
+      fit &= WriteLane<OutT, kWire32>(r, kLaneVersion, version);
+      if (kWire32) {
+        r[kLaneTimestamp] = static_cast<OutT>(static_cast<uint32_t>(ts));
+        r[kLane32TsHi] = static_cast<OutT>(ts >> 32);
+      } else {
+        r[kLaneTimestamp] = static_cast<OutT>(ts);
+      }
+      fit &= WriteLane<OutT, kWire32>(r, kLaneTaskId, task_id);
+      fit &= WriteLane<OutT, kWire32>(r, kLaneBatchFirst, batch_first);
       r[kLaneBatchLast] = (i == n_events - 1) ? 1 : 0;
-      int64_t* a0 = r + kLaneA0;
+      if (!fit) return -4;  // a narrow lane exceeds int32: int64 path only
+      int64_t a0_vals[8] = {0};
+      int64_t* a0 = a0_vals;
 
       // per-type attribute placement (ops/encode.py _encode_attrs)
       switch (type) {
@@ -248,38 +326,41 @@ int64_t PackOne(const uint8_t* blob, int64_t size, int64_t max_events,
           a0[0] = attrs[kAInitiatedEventId];
           break;
       }
+      // flush attr lanes to the row; wire32 splits a4 (expiration nanos)
+      for (int k = 0; k < 8; ++k) {
+        if (kWire32 && k == 4) {
+          r[kLaneA0 + 4] =
+              static_cast<OutT>(static_cast<uint32_t>(a0_vals[4]));
+          r[kLane32A4Hi] = static_cast<OutT>(a0_vals[4] >> 32);
+        } else if (!WriteLane<OutT, kWire32>(r, kLaneA0 + k, a0_vals[k])) {
+          return -4;
+        }
+      }
       ++row;
     }
   }
   if (!c.ok) return -1;
   // padding tail: zero lanes, event type -1
   for (int64_t e = row; e < max_events; ++e) {
-    std::memset(out + e * L, 0, sizeof(int64_t) * L);
-    out[e * L + kLaneEventType] = -1;
+    std::memset(out + e * L, 0, sizeof(OutT) * L);
+    out[e * L + kLaneEventType] = static_cast<OutT>(-1);
   }
   return row;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Pack W serialized histories into out[W, E, L]. offsets has W+1 entries
-// into blob. Returns total events packed, or -(workflow_index+1)*1000 - err
-// on the first failing workflow.
-int64_t cadence_pack_corpus(const uint8_t* blob, const int64_t* offsets,
-                            int64_t num_workflows, int64_t max_events,
-                            int64_t num_lanes, int64_t* out,
-                            int64_t num_threads) {
+template <typename OutT, bool kWire32>
+int64_t PackCorpus(const uint8_t* blob, const int64_t* offsets,
+                   int64_t num_workflows, int64_t max_events,
+                   int64_t num_lanes, OutT* out, int64_t num_threads) {
   if (num_threads < 1) num_threads = 1;
   std::vector<int64_t> totals(static_cast<size_t>(num_threads), 0);
   std::vector<int64_t> errs(static_cast<size_t>(num_threads), 0);
 
   auto work = [&](int64_t t) {
     for (int64_t w = t; w < num_workflows; w += num_threads) {
-      int64_t n = PackOne(blob + offsets[w], offsets[w + 1] - offsets[w],
-                          max_events, num_lanes,
-                          out + w * max_events * num_lanes);
+      int64_t n = PackOne<OutT, kWire32>(
+          blob + offsets[w], offsets[w + 1] - offsets[w], max_events,
+          num_lanes, out + w * max_events * num_lanes);
       if (n < 0) {
         errs[static_cast<size_t>(t)] = -(w + 1) * 1000 + n;
         return;
@@ -301,6 +382,31 @@ int64_t cadence_pack_corpus(const uint8_t* blob, const int64_t* offsets,
   int64_t total = 0;
   for (int64_t t : totals) total += t;
   return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack W serialized histories into out[W, E, L] int64. offsets has W+1
+// entries into blob. Returns total events packed, or
+// -(workflow_index+1)*1000 - err on the first failing workflow.
+int64_t cadence_pack_corpus(const uint8_t* blob, const int64_t* offsets,
+                            int64_t num_workflows, int64_t max_events,
+                            int64_t num_lanes, int64_t* out,
+                            int64_t num_threads) {
+  return PackCorpus<int64_t, false>(blob, offsets, num_workflows, max_events,
+                                    num_lanes, out, num_threads);
+}
+
+// wire32 variant: out[W, E, L32] int32 (ops/encode.py NUM_LANES32 schema,
+// timestamp + expiration split lo/hi). err -4: a narrow lane exceeds int32.
+int64_t cadence_pack_corpus32(const uint8_t* blob, const int64_t* offsets,
+                              int64_t num_workflows, int64_t max_events,
+                              int64_t num_lanes, int32_t* out,
+                              int64_t num_threads) {
+  return PackCorpus<int32_t, true>(blob, offsets, num_workflows, max_events,
+                                   num_lanes, out, num_threads);
 }
 
 }  // extern "C"

@@ -53,6 +53,46 @@ def pack_serialized(blobs: Sequence[bytes], max_events: int,
     return out
 
 
+def pack_serialized32(blobs: Sequence[bytes], max_events: int,
+                      num_threads: Optional[int] = None,
+                      out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pack W serialized histories into the wire32 transfer format
+    [W, max_events, NUM_LANES32] int32 (ops/encode.py: timestamp +
+    expiration split lo/hi, everything else range-checked) — 44% of the
+    int64 tensor's bytes on the host→device link."""
+    from ..ops.encode import NUM_LANES32
+
+    lib = _build.load()
+    if lib is None:
+        raise RuntimeError("native packer unavailable (no C++ toolchain)")
+    if num_threads is None:
+        num_threads = min(len(blobs), os.cpu_count() or 1)
+    W = len(blobs)
+    blob = b"".join(blobs)
+    offsets = np.zeros(W + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    if out is None:
+        out = np.empty((W, max_events, NUM_LANES32), dtype=np.int32)
+    else:
+        assert out.shape == (W, max_events, NUM_LANES32) and out.dtype == np.int32
+    rc = lib.cadence_pack_corpus32(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        W, max_events, NUM_LANES32,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        num_threads,
+    )
+    if rc < 0:
+        workflow = (-rc) // 1000 - 1
+        err = (-rc) % 1000
+        raise ValueError(
+            f"native packer failed on workflow {workflow} (code {err}: "
+            f"1=truncated, 2=unknown attr, 3=history exceeds max_events, "
+            f"4=lane exceeds int32 — use the int64 path)"
+        )
+    return out
+
+
 def encode_corpus_native(histories, max_events: int = 0) -> np.ndarray:
     """Drop-in native replacement for ops.encode.encode_corpus.
 

