@@ -84,6 +84,16 @@ def main(argv=None) -> int:
     reg = dom.add_parser("register")
     reg.add_argument("--name", required=True)
     reg.add_argument("--retention", type=int, default=0)
+    upd = dom.add_parser("update")
+    upd.add_argument("--name", required=True)
+    upd.add_argument("--retention", type=int, default=None)
+    upd.add_argument("--description", default=None)
+    upd.add_argument("--archival-uri", default=None)
+    upd.add_argument("--active-cluster", default=None)
+    upd.add_argument("--clusters", default=None,
+                     help="comma-separated; can only grow")
+    dep = dom.add_parser("deprecate")
+    dep.add_argument("--name", required=True)
     dom.add_parser("list")
 
     # workflow
@@ -110,6 +120,18 @@ def main(argv=None) -> int:
     lst = wf.add_parser("list")
     lst.add_argument("--domain", required=True)
     lst.add_argument("--closed", action="store_true")
+    lst.add_argument("--query", default=None,
+                     help="visibility query, e.g. \"WorkflowType = 'x' AND "
+                          "CloseStatus = 'Completed'\"")
+    cnt = wf.add_parser("count")
+    cnt.add_argument("--domain", required=True)
+    cnt.add_argument("--query", default="")
+    sws = wf.add_parser("signalwithstart")
+    sws.add_argument("--domain", required=True)
+    sws.add_argument("--workflow-id", required=True)
+    sws.add_argument("--type", required=True)
+    sws.add_argument("--task-list", required=True)
+    sws.add_argument("--name", required=True, help="signal name")
 
     # admin
     adm = sub.add_parser("admin").add_subparsers(dest="cmd", required=True)
@@ -137,9 +159,26 @@ def main(argv=None) -> int:
             domain_id = box.frontend.register_domain(
                 args.name, retention_days=args.retention)
             _emit({"registered": args.name, "domain_id": domain_id})
+        elif args.cmd == "update":
+            info = box.frontend.update_domain(
+                args.name, retention_days=args.retention,
+                description=args.description,
+                history_archival_uri=args.archival_uri,
+                active_cluster=args.active_cluster,
+                clusters=(args.clusters.split(",") if args.clusters
+                          else None))
+            _emit({"updated": info.name,
+                   "retention_days": info.retention_days,
+                   "active_cluster": info.active_cluster,
+                   "archival_uri": info.history_archival_uri,
+                   "notification_version": info.notification_version})
+        elif args.cmd == "deprecate":
+            info = box.frontend.deprecate_domain(args.name)
+            _emit({"deprecated": info.name})
         elif args.cmd == "list":
             _emit([{"name": d.name, "domain_id": d.domain_id,
-                    "retention_days": d.retention_days}
+                    "retention_days": d.retention_days,
+                    "status": d.status}
                    for d in box.frontend.list_domains()])
 
     elif args.group == "workflow":
@@ -169,12 +208,28 @@ def main(argv=None) -> int:
             box.pump_once()
             _emit({"terminated": args.workflow_id})
         elif args.cmd == "list":
-            recs = (box.frontend.list_closed_workflow_executions(args.domain)
-                    if args.closed else
-                    box.frontend.list_open_workflow_executions(args.domain))
+            if args.query is not None:
+                recs = box.frontend.list_workflow_executions(args.domain,
+                                                             args.query)
+            else:
+                recs = (box.frontend.list_closed_workflow_executions(args.domain)
+                        if args.closed else
+                        box.frontend.list_open_workflow_executions(args.domain))
             _emit([{"workflow_id": r.workflow_id, "run_id": r.run_id,
-                    "type": r.workflow_type, "close_status": r.close_status}
+                    "type": r.workflow_type, "close_status": r.close_status,
+                    "search_attrs": {k: (v.decode("utf-8", "replace")
+                                         if isinstance(v, bytes) else v)
+                                     for k, v in r.search_attrs.items()}}
                    for r in recs])
+        elif args.cmd == "count":
+            _emit({"count": box.frontend.count_workflow_executions(
+                args.domain, args.query)})
+        elif args.cmd == "signalwithstart":
+            run_id = box.frontend.signal_with_start_workflow_execution(
+                args.domain, args.workflow_id, args.name, args.type,
+                args.task_list)
+            box.pump_once()
+            _emit({"workflow_id": args.workflow_id, "run_id": run_id})
 
     elif args.group == "admin":
         if args.cmd == "describe-cluster":

@@ -134,7 +134,9 @@ def domain_record(info: DomainInfo) -> dict:
     return {"t": "d", "id": info.domain_id, "name": info.name,
             "ret": info.retention_days, "act": info.is_active,
             "ac": info.active_cluster, "cl": list(info.clusters),
-            "fv": info.failover_version, "nv": info.notification_version}
+            "fv": info.failover_version, "nv": info.notification_version,
+            "st": info.status, "desc": info.description,
+            "arc": info.history_archival_uri}
 
 
 def shard_record(info: ShardInfo) -> dict:
@@ -244,7 +246,9 @@ def recover_stores(path: str, verify_on_device: bool = True,
                 retention_days=rec["ret"], is_active=rec["act"],
                 active_cluster=rec["ac"], clusters=tuple(rec["cl"]),
                 failover_version=rec["fv"],
-                notification_version=rec["nv"])
+                notification_version=rec["nv"],
+                status=rec.get("st", 0), description=rec.get("desc", ""),
+                history_archival_uri=rec.get("arc", ""))
             try:
                 stores.domain.register(info)
             except Exception:

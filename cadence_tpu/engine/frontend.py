@@ -53,7 +53,8 @@ class PollActivityResponse:
 class Frontend:
     def __init__(self, stores: Stores, matching: MatchingEngine,
                  router: Callable[[str], HistoryEngine],
-                 config=None, metrics=None, time_source=None) -> None:
+                 config=None, metrics=None, time_source=None,
+                 cluster_name: str = "primary") -> None:
         from ..utils import metrics as m
         from ..utils.clock import RealTimeSource
         from ..utils.dynamicconfig import (
@@ -66,6 +67,7 @@ class Frontend:
         self.stores = stores
         self.matching = matching
         self.router = router
+        self.cluster_name = cluster_name
         self.config = config if config is not None else DynamicConfig()
         self.metrics = metrics if metrics is not None else m.DEFAULT_REGISTRY
         clock = time_source if time_source is not None else RealTimeSource()
@@ -109,6 +111,27 @@ class Frontend:
     def describe_domain(self, name: str) -> DomainInfo:
         return self.stores.domain.by_name(name)
 
+    def update_domain(self, name: str, retention_days: int = None,
+                      description: str = None, clusters=None,
+                      active_cluster: str = None,
+                      history_archival_uri: str = None) -> DomainInfo:
+        """UpdateDomain (workflowHandler.go:386): validated, live-effective
+        (retention feeds the scavenger, failover-version bump stamps later
+        events, archival URI arms archive-then-delete),
+        notification-version ordered."""
+        from .domain import update_domain
+        return update_domain(self.stores, name,
+                             local_cluster=self.cluster_name,
+                             retention_days=retention_days,
+                             description=description, clusters=clusters,
+                             active_cluster=active_cluster,
+                             history_archival_uri=history_archival_uri)
+
+    def deprecate_domain(self, name: str) -> DomainInfo:
+        """DeprecateDomain: rejects new starts, running workflows finish."""
+        from .domain import deprecate_domain
+        return deprecate_domain(self.stores, name)
+
     def list_domains(self) -> List[DomainInfo]:
         return self.stores.domain.list_domains()
 
@@ -125,7 +148,10 @@ class Frontend:
         from ..utils import metrics as m
         self._admit(domain, m.SCOPE_FRONTEND_START)
         self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
-        domain_id = self.stores.domain.by_name(domain).domain_id
+        from .domain import require_startable
+        info = self.stores.domain.by_name(domain)
+        require_startable(info)
+        domain_id = info.domain_id
         engine = self.router(workflow_id)
         return engine.start_workflow(
             domain_id=domain_id, workflow_id=workflow_id,
@@ -145,6 +171,26 @@ class Frontend:
         domain_id = self.stores.domain.by_name(domain).domain_id
         self.router(workflow_id).signal_workflow(domain_id, workflow_id,
                                                  signal_name, run_id)
+
+    def signal_with_start_workflow_execution(
+            self, domain: str, workflow_id: str, signal_name: str,
+            workflow_type: str, task_list: str,
+            execution_timeout: int = 3600, decision_timeout: int = 10,
+            cron_schedule: str = "", retry_policy=None) -> str:
+        """SignalWithStartWorkflowExecution (workflowHandler.go:2494):
+        signal the running execution, or atomically start one whose first
+        transaction carries the signal. Returns the run ID signaled or
+        started."""
+        from ..utils import metrics as m
+        self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
+        from .domain import require_startable
+        info = self.stores.domain.by_name(domain)
+        require_startable(info)
+        return self.router(workflow_id).signal_with_start_workflow(
+            info.domain_id, workflow_id, signal_name, workflow_type,
+            task_list, execution_timeout=execution_timeout,
+            decision_timeout=decision_timeout, cron_schedule=cron_schedule,
+            retry_policy=retry_policy)
 
     def request_cancel_workflow_execution(self, domain: str, workflow_id: str,
                                           run_id: Optional[str] = None) -> None:
@@ -373,12 +419,31 @@ class Frontend:
         notifier until events beyond `last_event_id` exist or the workflow
         closes (the reference's close-event wait policy), instead of
         busy-reading."""
-        domain_id = self.stores.domain.by_name(domain).domain_id
+        info = self.stores.domain.by_name(domain)
+        domain_id = info.domain_id
         engine = self.router(workflow_id)
-        if run_id is None:
-            run_id = self.stores.execution.get_current_run_id(domain_id,
-                                                              workflow_id)
-        events = engine.get_history(domain_id, workflow_id, run_id)
+        from .persistence import EntityNotExistsError
+        try:
+            if run_id is None:
+                run_id = self.stores.execution.get_current_run_id(domain_id,
+                                                                  workflow_id)
+            events = engine.get_history(domain_id, workflow_id, run_id)
+        except EntityNotExistsError:
+            # read-through to the archive: a retention-scavenged run whose
+            # domain archives stays readable (common/archiver Get path).
+            # With no run_id (the scavenge also dropped the current-run
+            # pointer), the most recently closed archived run serves.
+            from .archival import archiver_for
+            archiver = archiver_for(info.history_archival_uri)
+            if archiver is None:
+                raise
+            if run_id is None:
+                archived = archiver.runs(domain_id, workflow_id)
+                if not archived:
+                    raise
+                run_id = archived[0]
+            return [e for b in archiver.read(domain_id, workflow_id, run_id)
+                    for e in b.events]
         if wait_for_new_event and (not events or events[-1].id <= last_event_id):
             # an event BEYOND last_event_id exists iff the published
             # next_event_id reaches last_event_id + 2
@@ -401,6 +466,23 @@ class Frontend:
     def list_closed_workflow_executions(self, domain: str) -> List[VisibilityRecord]:
         domain_id = self.stores.domain.by_name(domain).domain_id
         return self.stores.visibility.list_closed(domain_id)
+
+    def list_workflow_executions(self, domain: str, query: str = ""
+                                 ) -> List[VisibilityRecord]:
+        """ListWorkflowExecutions with a query (workflowHandler.go:2837):
+        SQL-ish filters over built-in columns AND custom search attributes
+        (engine/visibility_query.py grammar)."""
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.stores.visibility.query(domain_id, query)
+
+    # ScanWorkflowExecutions (workflowHandler.go:3200) shares semantics
+    # with List in this store (no pagination-ordering split to preserve)
+    scan_workflow_executions = list_workflow_executions
+
+    def count_workflow_executions(self, domain: str, query: str = "") -> int:
+        """CountWorkflowExecutions (workflowHandler.go:3322)."""
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        return self.stores.visibility.count(domain_id, query)
 
     def describe_task_list(self, domain: str, task_list: str,
                            task_type: int = TASK_LIST_TYPE_DECISION

@@ -24,7 +24,7 @@ from __future__ import annotations
 import copy
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..core.checksum import Checksum
 from ..core.enums import (
@@ -290,7 +290,8 @@ class HistoryEngine:
                        run_id: Optional[str] = None,
                        initiator: Optional[ContinueAsNewInitiator] = None,
                        attempt: int = 0,
-                       expiration_timestamp: int = 0) -> str:
+                       expiration_timestamp: int = 0,
+                       initial_signals: Sequence[str] = ()) -> str:
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_START_WORKFLOW, m.M_REQUESTS)
         run_id = run_id or str(uuid.uuid4())
@@ -342,10 +343,19 @@ class HistoryEngine:
             HistoryEvent(id=1, event_type=EventType.WorkflowExecutionStarted,
                          version=version, timestamp=now, attrs=start_attrs),
         ]
+        # SignalWithStart: the signal events land in the START transaction,
+        # before the first decision schedule (historyEngine.go
+        # SignalWithStartWorkflowExecution orders started→signaled→decision)
+        for signal_name in initial_signals:
+            events.append(HistoryEvent(
+                id=len(events) + 1,
+                event_type=EventType.WorkflowExecutionSignaled,
+                version=version, timestamp=now,
+                attrs=dict(signal_name=signal_name)))
         # generateFirstDecisionTask (historyEngine.go:529) unless delayed
         if first_decision_backoff <= 0:
             events.append(HistoryEvent(
-                id=2, event_type=EventType.DecisionTaskScheduled,
+                id=len(events) + 1, event_type=EventType.DecisionTaskScheduled,
                 version=version, timestamp=now,
                 attrs=dict(task_list=task_list,
                            start_to_close_timeout_seconds=decision_timeout,
@@ -934,6 +944,57 @@ class HistoryEngine:
         txn.add(EventType.WorkflowExecutionSignaled, signal_name=signal_name)
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
+
+    def signal_with_start_workflow(self, domain_id: str, workflow_id: str,
+                                   signal_name: str, workflow_type: str,
+                                   task_list: str,
+                                   execution_timeout: int = 3600,
+                                   decision_timeout: int = 10,
+                                   cron_schedule: str = "",
+                                   retry_policy=None,
+                                   request_id: Optional[str] = None) -> str:
+        """SignalWithStartWorkflowExecution: signal the current run, or
+        atomically start a new run whose FIRST transaction already contains
+        the signal (workflowHandler.go:2489-2496; historyEngine.go
+        signalWithStartWorkflow). The signal-during-close race resolves by
+        retrying: a run that closes between the read and the signal commit
+        flips this call to the start arm; a start that loses the create
+        race flips it back to the signal arm — the create fence and the
+        next-event-id CAS make whichever arm wins atomic."""
+        from .persistence import (
+            ConditionFailedError,
+            WorkflowAlreadyStartedError,
+        )
+
+        for _ in range(5):
+            try:
+                run_id = self.stores.execution.get_current_run_id(
+                    domain_id, workflow_id)
+                ms = self.stores.execution.get_workflow(domain_id,
+                                                        workflow_id, run_id)
+                if ms.execution_info.state != WorkflowState.Completed:
+                    try:
+                        self.signal_workflow(domain_id, workflow_id,
+                                             signal_name, run_id)
+                        return run_id
+                    except (EntityNotExistsError, ConditionFailedError):
+                        # closed (or raced) between read and commit:
+                        # retry as a start
+                        continue
+            except EntityNotExistsError:
+                pass
+            try:
+                return self.start_workflow(
+                    domain_id=domain_id, workflow_id=workflow_id,
+                    workflow_type=workflow_type, task_list=task_list,
+                    execution_timeout=execution_timeout,
+                    decision_timeout=decision_timeout,
+                    cron_schedule=cron_schedule, retry_policy=retry_policy,
+                    request_id=request_id, initial_signals=(signal_name,))
+            except WorkflowAlreadyStartedError:
+                continue  # lost the create race: retry as a signal
+        raise InvalidRequestError(
+            f"signal_with_start {workflow_id}: unresolved start/close race")
 
     def request_cancel_workflow(self, domain_id: str, workflow_id: str,
                                 run_id: Optional[str] = None,

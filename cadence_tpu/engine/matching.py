@@ -123,6 +123,19 @@ class _TaskListManager:
         #: taskGC only deletes below the ack level, taskListManager.go)
         self._inflight: Dict[int, PersistedTask] = {}
         self._max_popped = 0
+        #: ids with LIVE obligations (buffered or in flight); the lazy min-
+        #: heap gives an O(log n) GC floor per ack — requeues can invert
+        #: buffer order, so no positional shortcut is safe
+        self._outstanding: set = set()
+        self._id_heap: List[int] = []
+        for t in self._buffer:
+            self._track_locked(t.task_id)
+
+    def _track_locked(self, task_id: int) -> None:
+        if task_id and task_id not in self._outstanding:
+            import heapq
+            self._outstanding.add(task_id)
+            heapq.heappush(self._id_heap, task_id)
 
     def _sync_match_locked(self, matched: MatchedTask) -> bool:
         while self._parked:
@@ -198,6 +211,7 @@ class _TaskListManager:
             # dispatch (taskReader pump)
             self._stores.task.create_tasks(self._info, [task])
             self._buffer.append(task)
+            self._track_locked(task.task_id)
 
     def _pop_locked(self) -> Optional[PersistedTask]:
         if not self._buffer:
@@ -216,19 +230,19 @@ class _TaskListManager:
         batched; a failed delete retries on the next ack)."""
         if not task_id:
             return
+        import heapq
         with self._lock:
             self._inflight.pop(task_id, None)
-            # buffer ids are ascending left-to-right (appends allocate
-            # monotonically; requeue_front returns an earlier — smaller —
-            # id to the head), so the first persisted entry IS the buffer
-            # minimum: O(1) per ack instead of rescanning the backlog
-            buf_min = next((t.task_id for t in self._buffer if t.task_id),
-                           None)
-            inf_min = min(self._inflight) if self._inflight else None
-            outstanding = [x for x in (buf_min, inf_min) if x is not None]
+            self._outstanding.discard(task_id)
+            # lazy min-heap: entries acked since their push are skimmed off
+            # the top; amortized O(log n) per ack even with requeue-order
+            # inversions in the buffer
+            while self._id_heap and self._id_heap[0] not in self._outstanding:
+                heapq.heappop(self._id_heap)
             # the store deletes ids <= level, so the GC level sits just
             # below the lowest still-outstanding id
-            level = min(outstanding) - 1 if outstanding else self._max_popped
+            level = (self._id_heap[0] - 1 if self._id_heap
+                     else self._max_popped)
             if level > self._ack:
                 self._ack = level
                 try:
@@ -251,6 +265,7 @@ class _TaskListManager:
         with self._lock:
             if task.task_id:
                 self._inflight.pop(task.task_id, None)
+                self._track_locked(task.task_id)
             self._buffer.appendleft(task)
 
     def add_query(self, domain_id: str, workflow_id: str, run_id: str,

@@ -510,6 +510,11 @@ class TaskStore:
 # ---------------------------------------------------------------------------
 
 
+#: DomainStatus (common/persistence DomainStatusRegistered/Deprecated)
+DOMAIN_STATUS_REGISTERED = 0
+DOMAIN_STATUS_DEPRECATED = 1
+
+
 @dataclass
 class DomainInfo:
     domain_id: str
@@ -520,6 +525,13 @@ class DomainInfo:
     clusters: Tuple[str, ...] = ("primary",)
     failover_version: int = 0
     notification_version: int = 0
+    #: DOMAIN_STATUS_*: deprecated domains reject new starts but existing
+    #: workflows run to completion (workflowHandler DeprecateDomain)
+    status: int = DOMAIN_STATUS_REGISTERED
+    description: str = ""
+    #: history archival URI ("" = disabled; file://<path> supported) —
+    #: retention archives-then-deletes when set (common/archiver)
+    history_archival_uri: str = ""
 
 
 class DomainStore:
@@ -580,6 +592,9 @@ class VisibilityRecord:
     start_time: int
     close_time: int = 0
     close_status: int = -1  # -1 = open
+    #: custom search attributes (UpsertWorkflowSearchAttributes decision) —
+    #: the advanced-visibility columns the query language filters on
+    search_attrs: Dict[str, object] = field(default_factory=dict)
 
 
 class VisibilityStore:
@@ -608,6 +623,29 @@ class VisibilityStore:
         with self._lock:
             return [r for r in self._records.values()
                     if r.domain_id == domain_id and r.close_status != -1]
+
+    def upsert_search_attributes(self, domain_id: str, workflow_id: str,
+                                 run_id: str, attrs: Dict[str, object]) -> None:
+        """The UpsertWorkflowSearchAttributes transfer task's visibility
+        write (the ES re-index analog)."""
+        with self._lock:
+            rec = self._records.get((domain_id, workflow_id, run_id))
+            if rec is not None:
+                rec.search_attrs.update(attrs)
+
+    def query(self, domain_id: str, query: str) -> List[VisibilityRecord]:
+        """Query-filtered scan (ListWorkflowExecutions with `query`,
+        workflowHandler.go:2837; ES translation reframed as an evaluated
+        predicate — engine/visibility_query.py)."""
+        from .visibility_query import compile_query
+        pred = compile_query(query)
+        with self._lock:
+            return [r for r in self._records.values()
+                    if r.domain_id == domain_id and pred(r)]
+
+    def count(self, domain_id: str, query: str = "") -> int:
+        """CountWorkflowExecutions (workflowHandler.go:3322)."""
+        return len(self.query(domain_id, query))
 
     def all_closed(self) -> List[VisibilityRecord]:
         with self._lock:

@@ -114,7 +114,17 @@ class QueueProcessors:
         elif tt == TransferTaskType.CancelExecution:
             self._cancel_external(engine, domain_id, workflow_id, run_id, task)
         elif tt == TransferTaskType.UpsertWorkflowSearchAttributes:
-            pass  # advanced-visibility reindex; records already visible
+            # advanced-visibility re-index (worker/indexer analog): fold
+            # the state's current attributes into the visibility record
+            try:
+                ms = self.stores.execution.get_workflow(domain_id,
+                                                        workflow_id, run_id)
+            except EntityNotExistsError:
+                self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
+                return
+            self.stores.visibility.upsert_search_attributes(
+                domain_id, workflow_id, run_id,
+                dict(ms.execution_info.search_attributes))
         elif tt == TransferTaskType.RecordChildExecutionCompleted:
             pass  # folded into _process_close's parent notification
         # remaining types (reset, parent close policy fan-out) arrive with
@@ -131,6 +141,7 @@ class QueueProcessors:
             domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
             workflow_type=ms.execution_info.workflow_type_name,
             start_time=ms.execution_info.start_timestamp,
+            search_attrs=dict(ms.execution_info.search_attributes),
         ))
 
     def _process_close(self, domain_id: str, workflow_id: str, run_id: str) -> None:

@@ -1,0 +1,178 @@
+"""Visibility query language: filtered List/Scan/Count.
+
+Reference: advanced visibility routes SQL-ish query strings to
+Elasticsearch (workflowHandler.go:2837-3322 ListWorkflowExecutions with
+`query`; common/elasticsearch/esql translates them). Here the same query
+surface compiles to a predicate evaluated over the visibility store's
+records — a recursive-descent parser for
+
+    expr       := term ("OR" term)*
+    term       := factor ("AND" factor)*
+    factor     := "(" expr ")" | comparison
+    comparison := field op value
+    op         := = | != | < | <= | > | >=
+    value      := number | 'string' | "string"
+
+Fields: the built-in columns WorkflowID, WorkflowType, RunID, CloseStatus
+(numeric or a CloseStatus name), StartTime, CloseTime — plus ANY custom
+search-attribute key (UpsertWorkflowSearchAttributes decision), exactly
+the split the reference indexes into ES.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+from ..core.enums import CloseStatus
+from .persistence import VisibilityRecord
+
+
+class QueryParseError(Exception):
+    """Malformed visibility query (BadRequestError in the reference)."""
+
+
+_TOKEN = re.compile(r"""\s*(?:
+    (?P<lparen>\() | (?P<rparen>\)) |
+    (?P<op><=|>=|!=|=|<|>) |
+    (?P<num>-?\d+(?:\.\d+)?) |
+    '(?P<sq>[^']*)' | "(?P<dq>[^"]*)" |
+    (?P<word>[A-Za-z_][A-Za-z0-9_.-]*)
+)""", re.VERBOSE)
+
+
+def _tokenize(query: str) -> List[Tuple[str, str]]:
+    tokens, pos = [], 0
+    while pos < len(query):
+        m = _TOKEN.match(query, pos)
+        if m is None or m.end() == pos:
+            if query[pos:].strip():
+                raise QueryParseError(f"bad token at: {query[pos:]!r}")
+            break
+        pos = m.end()
+        for kind in ("lparen", "rparen", "op", "num", "sq", "dq", "word"):
+            val = m.group(kind)
+            if val is not None:
+                if kind == "word" and val.upper() in ("AND", "OR"):
+                    tokens.append(("bool", val.upper()))
+                elif kind in ("sq", "dq"):
+                    tokens.append(("str", val))
+                else:
+                    tokens.append((kind, val))
+                break
+    return tokens
+
+
+_BUILTINS = {
+    "workflowid": lambda r: r.workflow_id,
+    "workflowtype": lambda r: r.workflow_type,
+    "runid": lambda r: r.run_id,
+    "closestatus": lambda r: r.close_status,
+    "starttime": lambda r: r.start_time,
+    "closetime": lambda r: r.close_time,
+}
+
+
+def _field_value(rec: VisibilityRecord, field: str):
+    getter = _BUILTINS.get(field.lower())
+    if getter is not None:
+        return getter(rec)
+    v = rec.search_attrs.get(field)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+_OPS: dict = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def take(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise QueryParseError("unexpected end of query")
+        self.pos += 1
+        return tok
+
+    def parse(self) -> Callable[[VisibilityRecord], bool]:
+        pred = self.expr()
+        if self.peek() is not None:
+            raise QueryParseError(f"trailing tokens: {self.tokens[self.pos:]}")
+        return pred
+
+    def expr(self):
+        left = self.term()
+        while self.peek() == ("bool", "OR"):
+            self.take()
+            right = self.term()
+            left = (lambda l, r: lambda rec: l(rec) or r(rec))(left, right)
+        return left
+
+    def term(self):
+        left = self.factor()
+        while self.peek() == ("bool", "AND"):
+            self.take()
+            right = self.factor()
+            left = (lambda l, r: lambda rec: l(rec) and r(rec))(left, right)
+        return left
+
+    def factor(self):
+        kind, val = self.take()
+        if kind == "lparen":
+            inner = self.expr()
+            if self.take()[0] != "rparen":
+                raise QueryParseError("unbalanced parentheses")
+            return inner
+        if kind != "word":
+            raise QueryParseError(f"expected a field name, got {val!r}")
+        field = val
+        op_kind, op = self.take()
+        if op_kind != "op":
+            raise QueryParseError(f"expected an operator after {field!r}")
+        vkind, raw = self.take()
+        if vkind == "num":
+            value: object = float(raw) if "." in raw else int(raw)
+        elif vkind == "str":
+            value = raw
+            if field.lower() == "closestatus":
+                try:
+                    value = int(CloseStatus[raw])
+                except KeyError:
+                    raise QueryParseError(
+                        f"unknown CloseStatus {raw!r} "
+                        f"(one of {[s.name for s in CloseStatus]})")
+        else:
+            raise QueryParseError(f"expected a value, got {raw!r}")
+        compare = _OPS[op]
+
+        def pred(rec: VisibilityRecord) -> bool:
+            actual = _field_value(rec, field)
+            if actual is None:
+                return False
+            try:
+                return compare(actual, value)
+            except TypeError:
+                return False
+
+        return pred
+
+
+def compile_query(query: str) -> Callable[[VisibilityRecord], bool]:
+    """Compile a visibility query string into a record predicate."""
+    tokens = _tokenize(query)
+    if not tokens:
+        return lambda rec: True  # empty query matches everything
+    return _Parser(tokens).parse()

@@ -41,23 +41,50 @@ class RetentionScavenger:
 
     def run_once(self) -> int:
         """Delete every closed run whose close time + domain retention is
-        past; returns how many runs were deleted."""
+        past; returns how many runs were deleted. Domains with an archival
+        URI ARCHIVE history (and the closed-visibility record) BEFORE the
+        delete (service/worker/archiver pump → common/archiver.Archive);
+        an archive failure SKIPS the delete — retention never destroys the
+        only copy (archive-then-delete ordering)."""
+        from dataclasses import asdict
+
+        from .archival import archiver_for
+
         now = self.clock.now()
         deleted = 0
+        archived = 0
         for rec in self.stores.visibility.all_closed():
             try:
-                retention_days = self.stores.domain.by_id(
-                    rec.domain_id).retention_days
+                domain = self.stores.domain.by_id(rec.domain_id)
+                retention_days = domain.retention_days
+                archival_uri = domain.history_archival_uri
             except EntityNotExistsError:
-                retention_days = 1
+                retention_days, archival_uri = 1, ""
             if rec.close_time + retention_days * _DAY_NANOS > now:
                 continue
+            archiver = archiver_for(archival_uri)
+            if archiver is not None:
+                try:
+                    batches = self.stores.history.as_history_batches(
+                        rec.domain_id, rec.workflow_id, rec.run_id)
+                    archiver.archive(rec.domain_id, rec.workflow_id,
+                                     rec.run_id, batches,
+                                     visibility=asdict(rec))
+                    archived += 1
+                except EntityNotExistsError:
+                    pass  # history already gone; nothing to preserve
+                except Exception:
+                    # archive failed (I/O, serialization): keep THIS run
+                    # and retry next pass — one bad record must not halt
+                    # retention for every other domain
+                    continue
             engine = self.router(rec.workflow_id)
             if engine.delete_workflow_execution(rec.domain_id,
                                                 rec.workflow_id, rec.run_id):
                 deleted += 1
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_WORKER_SCAVENGER, m.M_RUNS_DELETED, deleted)
+        self.metrics.inc(m.SCOPE_WORKER_SCAVENGER, m.M_RUNS_ARCHIVED, archived)
         return deleted
 
 

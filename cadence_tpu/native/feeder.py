@@ -40,29 +40,26 @@ class FeedReport:
         return self.events / self.pack_s if self.pack_s else 0.0
 
 
-def feed_serialized(blobs: Sequence[bytes], max_events: int,
-                    chunk_workflows: int = 4096,
-                    layout: PayloadLayout = DEFAULT_LAYOUT,
-                    num_threads: Optional[int] = None
-                    ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
-    """Replay W serialized histories chunk-by-chunk; returns
-    (payload rows [W, width], errors [W], FeedReport)."""
-    import jax
+def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
+          layout: PayloadLayout, num_threads: Optional[int],
+          num_lanes: int, dtype, pack_fn, replay_fn
+          ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
+    """The pipelined feed loop, shared by the int64 and wire32 formats.
 
-    from ..ops.replay import replay_to_payload
+    Bounded ring of pack buffers: pack into one while the device still
+    holds a transfer from another. Before REUSING a buffer, block until
+    the chunk that last used it has fully replayed — once its outputs
+    exist the input transfer has been consumed, so overwriting the host
+    buffer can no longer corrupt an in-flight H2D copy (this also bounds
+    the dispatch queue to `depth` chunks; unbounded async dispatch was a
+    real buffer-reuse race, VERDICT r3 weak #1)."""
+    import jax
 
     total = len(blobs)
     report = FeedReport(workflows=total)
-    # bounded ring of pack buffers: pack into one while the device still
-    # holds a transfer from another. Before REUSING a buffer, block until
-    # the chunk that last used it has fully replayed — once its outputs
-    # exist the input transfer has been consumed, so overwriting the host
-    # buffer can no longer corrupt an in-flight H2D copy (this also bounds
-    # the dispatch queue to `depth` chunks; unbounded async dispatch was a
-    # real buffer-reuse race, VERDICT r3 weak #1).
     depth = 2
-    buffers = [np.empty((chunk_workflows, max_events, packing.NUM_LANES),
-                        dtype=np.int64) for _ in range(depth)]
+    buffers = [np.empty((chunk_workflows, max_events, num_lanes),
+                        dtype=dtype) for _ in range(depth)]
     start = time.perf_counter()
     device_outs: List[Tuple] = []
     for ci, lo in enumerate(range(0, total, chunk_workflows)):
@@ -73,18 +70,31 @@ def feed_serialized(blobs: Sequence[bytes], max_events: int,
         if pad:
             chunk.extend([_EMPTY_BLOB] * pad)
         t0 = time.perf_counter()
-        packed = packing.pack_serialized(chunk, max_events,
-                                         num_threads=num_threads,
-                                         out=buffers[ci % depth])
+        packed = pack_fn(chunk, max_events, num_threads=num_threads,
+                         out=buffers[ci % depth])
         report.pack_s += time.perf_counter() - t0
         report.events += int((packed[:, :, 0] > 0).sum())
         # async dispatch: the device crunches while the next chunk packs
-        device_outs.append(replay_to_payload(jax.device_put(packed), layout))
+        device_outs.append(replay_fn(jax.device_put(packed), layout))
         report.chunks += 1
-    rows = np.concatenate([np.asarray(r) for r, _ in device_outs])[:total]
+    first = np.concatenate([np.asarray(r) for r, _ in device_outs])[:total]
     errors = np.concatenate([np.asarray(e) for _, e in device_outs])[:total]
     report.wall_s = time.perf_counter() - start
-    return rows, errors, report
+    return first, errors, report
+
+
+def feed_serialized(blobs: Sequence[bytes], max_events: int,
+                    chunk_workflows: int = 4096,
+                    layout: PayloadLayout = DEFAULT_LAYOUT,
+                    num_threads: Optional[int] = None
+                    ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
+    """Replay W serialized histories chunk-by-chunk; returns
+    (payload rows [W, width], errors [W], FeedReport)."""
+    from ..ops.replay import replay_to_payload
+
+    return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
+                 packing.NUM_LANES, np.int64, packing.pack_serialized,
+                 replay_to_payload)
 
 
 #: serialized empty history (0 batches) — pads the tail chunk to the
@@ -100,39 +110,12 @@ def feed_serialized32(blobs: Sequence[bytes], max_events: int,
     """The production ingest pipeline: wire bytes → C++ wire32 packer →
     int32 H2D (44% of the int64 bytes) → device replay+checksum → 4
     bytes/workflow back. Returns (crc32 [W] uint32, errors [W], report)."""
-    import jax
-
     from ..ops.encode import NUM_LANES32
     from ..ops.replay import replay_to_crc32
 
-    total = len(blobs)
-    report = FeedReport(workflows=total)
-    depth = 2
-    buffers = [np.empty((chunk_workflows, max_events, NUM_LANES32),
-                        dtype=np.int32) for _ in range(depth)]
-    start = time.perf_counter()
-    device_outs: List[Tuple] = []
-    for ci, lo in enumerate(range(0, total, chunk_workflows)):
-        if ci >= depth:
-            # safe buffer reuse: the chunk that last packed into this
-            # buffer must have fully replayed (its H2D is consumed)
-            jax.block_until_ready(device_outs[ci - depth])
-        chunk = list(blobs[lo:lo + chunk_workflows])
-        pad = chunk_workflows - len(chunk)
-        if pad:
-            chunk.extend([_EMPTY_BLOB] * pad)
-        t0 = time.perf_counter()
-        packed = packing.pack_serialized32(chunk, max_events,
-                                           num_threads=num_threads,
-                                           out=buffers[ci % depth])
-        report.pack_s += time.perf_counter() - t0
-        report.events += int((packed[:, :, 0] > 0).sum())
-        device_outs.append(replay_to_crc32(jax.device_put(packed), layout))
-        report.chunks += 1
-    crcs = np.concatenate([np.asarray(c) for c, _ in device_outs])[:total]
-    errors = np.concatenate([np.asarray(e) for _, e in device_outs])[:total]
-    report.wall_s = time.perf_counter() - start
-    return crcs, errors, report
+    return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
+                 NUM_LANES32, np.int32, packing.pack_serialized32,
+                 replay_to_crc32)
 
 
 def feed_corpus(histories, chunk_workflows: int = 4096,

@@ -177,18 +177,20 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
         hosts[name] = port
     for name, port in hosts.items():
         _wait_listening(port, procs[name])
-    # let every host see every peer before handing the cluster out
+    # let every host's RING converge on the full peer set before handing
+    # the cluster out (a host still on a single-member ring believes it
+    # owns every shard → spurious steal churn on first requests)
     deadline = time.monotonic() + 10
     want = set(hosts)
     while time.monotonic() < deadline:
         views = []
         for name, port in hosts.items():
             try:
-                views.append(call(("127.0.0.1", store_port),
-                                  ("peers", ttl), timeout=2))
+                ping = call(("127.0.0.1", port), ("ping",), timeout=2)
+                views.append(set(ping[3]))
             except Exception:
-                views.append([])
-        if all({h for h, _ in v} >= want for v in views):
+                views.append(set())
+        if all(v >= want for v in views):
             break
         time.sleep(0.05)
     return Cluster(store_port, hosts, procs, store_proc)

@@ -254,7 +254,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     result = None
                 elif op == "ping":
                     result = ("pong", server.name,
-                              server.controller.owned_shards())
+                              server.controller.owned_shards(),
+                              server.ring.members())
                 else:
                     raise ValueError(f"unknown op {op!r}")
                 response = ("ok", result)

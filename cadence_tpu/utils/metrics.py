@@ -56,6 +56,7 @@ M_FALLBACK_RATE = "fallback-rate"
 M_BUFFERED_FLUSHED = "buffered-events-flushed"
 M_RATE_LIMITED = "requests-rate-limited"
 M_RUNS_DELETED = "runs-deleted"
+M_RUNS_ARCHIVED = "runs-archived"
 M_EXECUTIONS_SCANNED = "executions-scanned"
 M_INVARIANT_VIOLATIONS = "invariant-violations"
 

@@ -211,3 +211,30 @@ class TestTwoPhaseAck:
         assert again is not None and again.task_id == task.task_id
         eng2.complete_task(again, TASK_LIST_TYPE_DECISION)
         assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []
+
+    def test_requeue_inversion_never_gcs_live_tasks(self):
+        """Requeues can invert buffer order; the GC floor must still sit
+        below EVERY live task (code-review r4: a positional buffer-min
+        shortcut deleted a requeued task's persisted row)."""
+        from cadence_tpu.engine.matching import TASK_LIST_TYPE_DECISION
+        stores, eng = self._stores_engine()
+        for i in range(3):
+            eng.add_decision_task("d", TL, f"wf-{i}", "run", 2)
+        t1 = eng.poll_for_decision_task("d", TL)
+        t2 = eng.poll_for_decision_task("d", TL)
+        t3 = eng.poll_for_decision_task("d", TL)
+        assert t1.task_id < t2.task_id < t3.task_id
+        # requeue t1 then t2: buffer becomes [t2, t1] — order inverted
+        eng.requeue_task(t1, TASK_LIST_TYPE_DECISION)
+        eng.requeue_task(t2, TASK_LIST_TYPE_DECISION)
+        eng.complete_task(t3, TASK_LIST_TYPE_DECISION)
+        remaining = {t.task_id
+                     for t in stores.task.get_tasks("d", TL,
+                                                    TASK_LIST_TYPE_DECISION, 0)}
+        assert {t1.task_id, t2.task_id} <= remaining
+        # drain the requeued pair; the store empties only then
+        a = eng.poll_for_decision_task("d", TL)
+        b = eng.poll_for_decision_task("d", TL)
+        eng.complete_task(a, TASK_LIST_TYPE_DECISION)
+        eng.complete_task(b, TASK_LIST_TYPE_DECISION)
+        assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []

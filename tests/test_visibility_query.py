@@ -1,0 +1,100 @@
+"""Advanced visibility: query-filtered List/Scan/Count over search
+attributes (VERDICT r3 ask #4; workflowHandler.go:2837-3322, ES query
+surface reframed as an evaluated predicate).
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.engine.visibility_query import QueryParseError, compile_query
+from cadence_tpu.engine.persistence import VisibilityRecord
+from cadence_tpu.models.deciders import EchoDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "vq-domain"
+TL = "vq-tl"
+
+
+def rec(**kw):
+    base = dict(domain_id="d", workflow_id="w", run_id="r",
+                workflow_type="t", start_time=100)
+    base.update(kw)
+    return VisibilityRecord(**base)
+
+
+class TestQueryLanguage:
+    def test_builtin_fields_and_ops(self):
+        p = compile_query("WorkflowType = 'order' AND StartTime >= 100")
+        assert p(rec(workflow_type="order", start_time=100))
+        assert not p(rec(workflow_type="order", start_time=99))
+        assert not p(rec(workflow_type="other", start_time=200))
+
+    def test_or_and_parens(self):
+        p = compile_query(
+            "(WorkflowID = 'a' OR WorkflowID = 'b') AND CloseStatus = 0")
+        assert p(rec(workflow_id="a", close_status=0))
+        assert p(rec(workflow_id="b", close_status=0))
+        assert not p(rec(workflow_id="c", close_status=0))
+        assert not p(rec(workflow_id="a", close_status=1))
+
+    def test_close_status_by_name(self):
+        p = compile_query("CloseStatus = 'Completed'")
+        assert p(rec(close_status=int(CloseStatus.Completed)))
+        assert not p(rec(close_status=int(CloseStatus.Failed)))
+
+    def test_custom_search_attributes(self):
+        p = compile_query("CustomKeywordField = 'v' AND Priority > 3")
+        assert p(rec(search_attrs={"CustomKeywordField": b"v", "Priority": 5}))
+        assert not p(rec(search_attrs={"CustomKeywordField": b"v"}))
+        assert not p(rec(search_attrs={}))
+
+    def test_parse_errors(self):
+        for bad in ("WorkflowID ==", "AND", "WorkflowID = ", "(a = 1",
+                    "CloseStatus = 'NotAStatus'", "x = 1 extra junk %"):
+            with pytest.raises(QueryParseError):
+                compile_query(bad)
+
+    def test_empty_query_matches_all(self):
+        assert compile_query("")(rec())
+
+
+class TestListCountEndToEnd:
+    def test_upserted_attributes_are_queryable(self):
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-a", "order", TL)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-b", "refund", TL)
+        box.pump_once()
+
+        # first decision upserts a search attribute on wf-a, completes wf-b
+        for _ in range(8):
+            resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+            if resp is None:
+                if box.pump_once() == 0:
+                    break
+                continue
+            if resp.token.workflow_id == "wf-a":
+                box.frontend.respond_decision_task_completed(resp.token, [
+                    Decision(DecisionType.UpsertWorkflowSearchAttributes,
+                             {"search_attributes": {"Tier": b"gold",
+                                                    "Priority": 7}})])
+            else:
+                box.frontend.respond_decision_task_completed(resp.token, [
+                    Decision(DecisionType.CompleteWorkflowExecution,
+                             {"result": b""})])
+        box.pump_once()
+
+        hits = box.frontend.list_workflow_executions(
+            DOMAIN, "Tier = 'gold' AND Priority >= 5")
+        assert [r.workflow_id for r in hits] == ["wf-a"]
+        assert box.frontend.count_workflow_executions(
+            DOMAIN, "Tier = 'gold'") == 1
+        assert box.frontend.count_workflow_executions(
+            DOMAIN, "CloseStatus = 'Completed'") == 1
+        assert box.frontend.count_workflow_executions(DOMAIN) == 2
+        assert box.frontend.count_workflow_executions(
+            DOMAIN, "WorkflowType = 'order' AND CloseStatus = 'Completed'") == 0
+        # scan shares list semantics
+        assert [r.workflow_id for r in box.frontend.scan_workflow_executions(
+            DOMAIN, "WorkflowType = 'refund'")] == ["wf-b"]
