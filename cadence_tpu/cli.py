@@ -126,6 +126,14 @@ def main(argv=None) -> int:
     cnt = wf.add_parser("count")
     cnt.add_argument("--domain", required=True)
     cnt.add_argument("--query", default="")
+    bat = wf.add_parser("batch")
+    bat.add_argument("--domain", required=True)
+    bat.add_argument("--query", required=True)
+    bat.add_argument("--op", required=True,
+                     choices=("terminate", "cancel", "signal"))
+    bat.add_argument("--name", default="", help="signal name (op=signal)")
+    bat.add_argument("--reason", default="cli batch")
+    bat.add_argument("--rps", type=float, default=50.0)
     sws = wf.add_parser("signalwithstart")
     sws.add_argument("--domain", required=True)
     sws.add_argument("--workflow-id", required=True)
@@ -224,6 +232,14 @@ def main(argv=None) -> int:
         elif args.cmd == "count":
             _emit({"count": box.frontend.count_workflow_executions(
                 args.domain, args.query)})
+        elif args.cmd == "batch":
+            from .engine.batcher import Batcher
+            report = Batcher(box.frontend, box.clock, rps=args.rps).run(
+                args.domain, args.query, args.op, reason=args.reason,
+                signal_name=args.name)
+            box.pump_once()
+            _emit({"total": report.total, "succeeded": report.succeeded,
+                   "failed": report.failed, "failures": report.failures})
         elif args.cmd == "signalwithstart":
             run_id = box.frontend.signal_with_start_workflow_execution(
                 args.domain, args.workflow_id, args.name, args.type,

@@ -14,10 +14,26 @@ from .persistence import EntityNotExistsError
 
 
 class AdminHandler:
-    """Operator API over one cluster (an Onebox or equivalent wiring)."""
+    """Operator API over one cluster (an Onebox or equivalent wiring).
 
-    def __init__(self, box) -> None:
+    Every method passes the authorization seam with PERMISSION_ADMIN
+    (accessControlledHandler + authorizer.go:88): the default Noop
+    authorizer allows all, but wiring a real one closes the admin
+    surface — VERDICT r3 ask #9."""
+
+    def __init__(self, box, authorizer=None, actor: str = "") -> None:
+        from .authorization import NoopAuthorizer
         self.box = box
+        self.authorizer = (authorizer if authorizer is not None
+                           else getattr(box, "authorizer", None)
+                           or NoopAuthorizer())
+        self.actor = actor
+
+    def _authorize(self, api: str) -> None:
+        from .authorization import PERMISSION_ADMIN, AuthAttributes, check
+        check(self.authorizer, AuthAttributes(api=f"admin.{api}",
+                                              permission=PERMISSION_ADMIN,
+                                              actor=self.actor))
 
     # -- execution introspection (adminHandler DescribeWorkflowExecution) --
 
@@ -26,6 +42,7 @@ class AdminHandler:
                                     ) -> Dict[str, Any]:
         """Raw mutable state: execution info, pending tables, version
         histories, buffered events, checksum."""
+        self._authorize("describe_workflow_execution")
         stores = self.box.stores
         domain_id = stores.domain.by_name(domain).domain_id
         if run_id is None:
@@ -65,6 +82,7 @@ class AdminHandler:
     # -- host / shard introspection (DescribeHistoryHost, handler.go:741) --
 
     def describe_history_host(self, host: str) -> Dict[str, Any]:
+        self._authorize("describe_history_host")
         controller = self.box.controllers[host]
         shards = sorted(controller.assigned_shards())
         return {"host": host, "shard_count": len(shards),
@@ -72,6 +90,7 @@ class AdminHandler:
                 "num_shards_total": self.box.num_shards}
 
     def describe_cluster(self) -> Dict[str, Any]:
+        self._authorize("describe_cluster")
         return {
             "cluster": self.box.cluster_name,
             "hosts": {h: self.describe_history_host(h)["shard_count"]
@@ -85,6 +104,7 @@ class AdminHandler:
     # -- queue introspection (DescribeQueue, handler.go:851) ---------------
 
     def describe_queue(self, shard_id: int) -> Dict[str, Any]:
+        self._authorize("describe_queue")
         for controller in self.box.controllers.values():
             try:
                 engine = controller.engine_for_shard(shard_id)
@@ -103,6 +123,7 @@ class AdminHandler:
     def close_shard(self, shard_id: int) -> bool:
         """CloseShard (adminHandler): force the owning engine's shard
         closed so the next write fences and ownership re-acquires."""
+        self._authorize("close_shard")
         for controller in self.box.controllers.values():
             try:
                 engine = controller.engine_for_shard(shard_id)
@@ -116,16 +137,19 @@ class AdminHandler:
 
     def get_dynamic_config(self, key: str,
                            domain: Optional[str] = None) -> Any:
+        self._authorize("get_dynamic_config")
         return self.box.config.get(key, domain=domain)
 
     def update_dynamic_config(self, key: str, value: Any,
                               domain: Optional[str] = None) -> None:
+        self._authorize("update_dynamic_config")
         self.box.config.set(key, value, domain=domain)
 
     # -- maintenance passthroughs ------------------------------------------
 
     def refresh_workflow_tasks(self, domain: str, workflow_id: str,
                                run_id: Optional[str] = None) -> int:
+        self._authorize("refresh_workflow_tasks")
         domain_id = self.box.stores.domain.by_name(domain).domain_id
         return self.box.route(workflow_id).refresh_tasks(domain_id,
                                                          workflow_id, run_id)
@@ -133,4 +157,5 @@ class AdminHandler:
     def verify(self, keys: Optional[List] = None):
         """Device bulk verify (the scanner's state invariant, exposed to
         operators like the CLI admin db scan)."""
+        self._authorize("verify")
         return self.box.tpu.verify_all(keys)

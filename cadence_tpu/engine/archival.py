@@ -52,8 +52,19 @@ class FilestoreHistoryArchiver:
     def __init__(self, root: str) -> None:
         self.root = root
 
+    @staticmethod
+    def _component(s: str) -> str:
+        """Bijective, traversal-proof path component: percent-encode
+        everything outside [A-Za-z0-9_-] (so 'a/b' and 'a_b' cannot
+        collide) and dot-only names ('.', '..') cannot escape."""
+        from urllib.parse import quote
+        enc = quote(s, safe="")
+        if set(enc) <= {"."}:
+            enc = enc.replace(".", "%2E")
+        return enc
+
     def _paths(self, domain_id: str, workflow_id: str, run_id: str):
-        safe = [s.replace("/", "_") for s in (domain_id, workflow_id, run_id)]
+        safe = [self._component(s) for s in (domain_id, workflow_id, run_id)]
         base = os.path.join(self.root, *safe[:2])
         return (os.path.join(base, safe[2] + ".hist"),
                 os.path.join(base, safe[2] + ".vis"))
@@ -95,15 +106,16 @@ class FilestoreHistoryArchiver:
         (by the .vis close_time, falling back to file mtime) — serves the
         run_id-less read-through after retention deleted the live current
         pointer."""
-        base = os.path.join(self.root, domain_id.replace("/", "_"),
-                            workflow_id.replace("/", "_"))
+        from urllib.parse import unquote
+        base = os.path.join(self.root, self._component(domain_id),
+                            self._component(workflow_id))
         if not os.path.isdir(base):
             return []
         out = []
         for name in os.listdir(base):
             if not name.endswith(".hist"):
                 continue
-            run_id = name[:-len(".hist")]
+            run_id = unquote(name[:-len(".hist")])
             vis = self.read_visibility(domain_id, workflow_id, run_id)
             close_time = (vis or {}).get("close_time") or int(
                 os.path.getmtime(os.path.join(base, name)) * 1e9)

@@ -74,7 +74,11 @@ def update_domain(stores, name: str, *, local_cluster: str,
         raise DomainValidationError(f"domain {name} is deprecated")
     if retention_days is not None:
         validate_retention(retention_days)
-    validate_cluster_change(info, clusters, active_cluster, meta)
+    if clusters is not None or active_cluster is not None:
+        # replication-config rules apply only when the config changes: a
+        # description-only update must not re-litigate an existing cluster
+        # set against a different cluster group's metadata
+        validate_cluster_change(info, clusters, active_cluster, meta)
     if history_archival_uri:
         from .archival import ArchivalError, archiver_for
         try:

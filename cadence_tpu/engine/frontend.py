@@ -68,6 +68,15 @@ class Frontend:
         self.matching = matching
         self.router = router
         self.cluster_name = cluster_name
+        # authorization seam: Noop by default (reference posture); hosts
+        # inject a real authorizer + per-connection actor identity
+        from .authorization import NoopAuthorizer
+        self.authorizer = NoopAuthorizer()
+        self.actor = ""
+        #: the cluster group this frontend validates replication configs
+        #: against (cluster/metadata.go); multi-cluster wiring replaces it
+        from .cluster import ClusterMetadata
+        self.cluster_meta = ClusterMetadata()
         self.config = config if config is not None else DynamicConfig()
         self.metrics = metrics if metrics is not None else m.DEFAULT_REGISTRY
         clock = time_source if time_source is not None else RealTimeSource()
@@ -88,6 +97,12 @@ class Frontend:
             self.metrics.inc(scope, m.M_RATE_LIMITED)
             raise ServiceBusyError(f"domain {domain} over request limit")
 
+    def _authorize(self, api: str, permission: str, domain: str = "") -> None:
+        from .authorization import AuthAttributes, check
+        check(self.authorizer, AuthAttributes(api=api, permission=permission,
+                                              domain=domain,
+                                              actor=self.actor))
+
     # -- domains (workflowHandler.go:265-437) ------------------------------
 
     def register_domain(self, name: str, retention_days: int = 0,
@@ -98,6 +113,8 @@ class Frontend:
                         domain_id: str = "") -> str:
         """Domain CRUD (workflowHandler.go:265). Global domains pass the same
         domain_id on every cluster (the domain-replication invariant)."""
+        from .authorization import PERMISSION_ADMIN
+        self._authorize("RegisterDomain", PERMISSION_ADMIN, name)
         from ..utils.dynamicconfig import KEY_RETENTION_DAYS_DEFAULT
         if retention_days <= 0:
             retention_days = int(self.config.get(KEY_RETENTION_DAYS_DEFAULT))
@@ -119,9 +136,12 @@ class Frontend:
         (retention feeds the scavenger, failover-version bump stamps later
         events, archival URI arms archive-then-delete),
         notification-version ordered."""
+        from .authorization import PERMISSION_ADMIN
+        self._authorize("UpdateDomain", PERMISSION_ADMIN, name)
         from .domain import update_domain
         return update_domain(self.stores, name,
                              local_cluster=self.cluster_name,
+                             meta=self.cluster_meta,
                              retention_days=retention_days,
                              description=description, clusters=clusters,
                              active_cluster=active_cluster,
@@ -129,6 +149,8 @@ class Frontend:
 
     def deprecate_domain(self, name: str) -> DomainInfo:
         """DeprecateDomain: rejects new starts, running workflows finish."""
+        from .authorization import PERMISSION_ADMIN
+        self._authorize("DeprecateDomain", PERMISSION_ADMIN, name)
         from .domain import deprecate_domain
         return deprecate_domain(self.stores, name)
 
@@ -146,6 +168,8 @@ class Frontend:
                                  retry_policy: Optional[RetryPolicy] = None,
                                  ) -> str:
         from ..utils import metrics as m
+        from .authorization import PERMISSION_WRITE
+        self._authorize("StartWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_START)
         self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
         from .domain import require_startable
@@ -167,6 +191,8 @@ class Frontend:
                                   signal_name: str,
                                   run_id: Optional[str] = None) -> None:
         from ..utils import metrics as m
+        from .authorization import PERMISSION_WRITE
+        self._authorize("SignalWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         domain_id = self.stores.domain.by_name(domain).domain_id
         self.router(workflow_id).signal_workflow(domain_id, workflow_id,
@@ -182,6 +208,9 @@ class Frontend:
         transaction carries the signal. Returns the run ID signaled or
         started."""
         from ..utils import metrics as m
+        from .authorization import PERMISSION_WRITE
+        self._authorize("SignalWithStartWorkflowExecution", PERMISSION_WRITE,
+                        domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         from .domain import require_startable
         info = self.stores.domain.by_name(domain)
@@ -194,6 +223,9 @@ class Frontend:
 
     def request_cancel_workflow_execution(self, domain: str, workflow_id: str,
                                           run_id: Optional[str] = None) -> None:
+        from .authorization import PERMISSION_WRITE
+        self._authorize("RequestCancelWorkflowExecution", PERMISSION_WRITE,
+                        domain)
         domain_id = self.stores.domain.by_name(domain).domain_id
         self.router(workflow_id).request_cancel_workflow(domain_id, workflow_id,
                                                          run_id)
@@ -201,6 +233,8 @@ class Frontend:
     def terminate_workflow_execution(self, domain: str, workflow_id: str,
                                      run_id: Optional[str] = None,
                                      reason: str = "") -> None:
+        from .authorization import PERMISSION_WRITE
+        self._authorize("TerminateWorkflowExecution", PERMISSION_WRITE, domain)
         domain_id = self.stores.domain.by_name(domain).domain_id
         self.router(workflow_id).terminate_workflow(domain_id, workflow_id,
                                                     run_id, reason)

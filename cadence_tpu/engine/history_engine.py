@@ -81,9 +81,13 @@ class HistoryEngine:
 
     def __init__(self, shard: ShardContext, stores: Stores,
                  time_source: TimeSource) -> None:
+        from ..utils.log import DEFAULT_LOGGER
         self.shard = shard
         self.stores = stores
         self.clock = time_source
+        #: tagged structured logger (log/tag ShardID; loggerimpl.WithTags)
+        self.log = DEFAULT_LOGGER.with_tags(component="history",
+                                            shard_id=shard.shard_id)
         #: shared holder so a cluster can attach its replication publisher to
         #: engines created before/after wiring ({"pub": ReplicationPublisher})
         self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
@@ -1523,6 +1527,12 @@ class _Txn:
         self.engine.shard.commit_workflow(
             self.ms, expected_next_event_id, self.events,
             new_transfer, new_timer)
+        self.engine.log.debug(
+            "transaction committed", domain_id=info.domain_id,
+            workflow_id=info.workflow_id, run_id=info.run_id,
+            first_event_id=self.events[0].id,
+            next_event_id=info.next_event_id,
+            transfer_tasks=len(new_transfer), timer_tasks=len(new_timer))
         self.engine._publish_replication(info.domain_id, info.workflow_id,
                                          info.run_id, self.events, self.ms)
         # wake history long-polls (events/notifier.go NotifyNewHistoryEvent)

@@ -40,6 +40,10 @@ class Onebox:
         #: runtime knobs (common/dynamicconfig analog) + cluster metrics
         self.config = config if config is not None else DynamicConfig()
         self.metrics = MetricsRegistry()
+        # authorization seam (authorizer.go:88): Noop unless the operator
+        # wires a real authorizer; AdminHandler and the frontend consult it
+        from .authorization import NoopAuthorizer
+        self.authorizer = NoopAuthorizer()
         self.cluster_name = cluster_name
         self.num_shards = num_shards
         #: shared across every engine this cluster creates

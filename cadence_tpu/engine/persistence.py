@@ -603,16 +603,38 @@ class VisibilityStore:
         self._records: Dict[Tuple[str, str, str], VisibilityRecord] = {}
 
     def record_started(self, rec: VisibilityRecord) -> None:
+        """Upsert the open-execution record. Under a CONCURRENT task pump
+        the close task can land before a retried start task — the start
+        write must never resurrect a closed record as open (it merges the
+        existing close fields and search attrs instead of replacing)."""
         with self._lock:
-            self._records[(rec.domain_id, rec.workflow_id, rec.run_id)] = rec
+            key = (rec.domain_id, rec.workflow_id, rec.run_id)
+            existing = self._records.get(key)
+            if existing is not None:
+                rec.close_time = existing.close_time
+                rec.close_status = existing.close_status
+                merged = dict(existing.search_attrs)
+                merged.update(rec.search_attrs)
+                rec.search_attrs = merged
+            self._records[key] = rec
 
     def record_closed(self, domain_id: str, workflow_id: str, run_id: str,
-                      close_time: int, close_status: int) -> None:
+                      close_time: int, close_status: int,
+                      workflow_type: str = "", start_time: int = 0) -> None:
+        """Upsert close data — creating the record when the start write
+        hasn't landed yet (out-of-order under the concurrent pump): a
+        closed workflow must never stay listed open forever because its
+        start task retried late."""
         with self._lock:
             rec = self._records.get((domain_id, workflow_id, run_id))
-            if rec is not None:
-                rec.close_time = close_time
-                rec.close_status = close_status
+            if rec is None:
+                rec = VisibilityRecord(
+                    domain_id=domain_id, workflow_id=workflow_id,
+                    run_id=run_id, workflow_type=workflow_type,
+                    start_time=start_time)
+                self._records[(domain_id, workflow_id, run_id)] = rec
+            rec.close_time = close_time
+            rec.close_status = close_status
 
     def list_open(self, domain_id: str) -> List[VisibilityRecord]:
         with self._lock:

@@ -74,6 +74,54 @@ class QueueProcessors:
     # transfer queue
     # ------------------------------------------------------------------
 
+    def process_transfer_concurrent(self, scheduler) -> int:
+        """N-worker transfer processing (parallelTaskProcessor +
+        weightedRoundRobin + redispatcher + ack manager): tasks submit to
+        the pool keyed by DOMAIN (per-domain fairness), complete out of
+        order, and each shard's persisted ack level advances only past the
+        contiguous completed prefix — a crash mid-pool never skips a
+        straggler. Transient failures raise RetryableTaskError inside the
+        job and redispatch with attempts; poison tasks land in
+        scheduler.dead (counted, never silently dropped)."""
+        from .faults import TransientStoreError
+        from .persistence import ConditionFailedError, ShardOwnershipLostError
+        from .tasks import AckManager, RetryableTaskError
+
+        if not hasattr(self, "_transfer_acks"):
+            self._transfer_acks = {}
+        submitted = 0
+        for shard_id in self.controller.assigned_shards():
+            engine = self.controller.engine_for_shard(shard_id)
+            shard = engine.shard
+            ack = self._transfer_acks.get(shard_id)
+            if ack is None:
+                ack = self._transfer_acks[shard_id] = AckManager(
+                    shard.transfer_ack_level)
+            tasks = shard.read_transfer_tasks(ack.ack_level())
+            for task_id, domain_id, workflow_id, run_id, task in tasks:
+                if not ack.register(task_id):
+                    continue  # already in flight from a previous sweep
+
+                def job(e=engine, d=domain_id, w=workflow_id, r=run_id,
+                        t=task):
+                    try:
+                        self._execute_transfer(e, d, w, r, t)
+                    except (ShardOwnershipLostError, ConditionFailedError,
+                            TransientStoreError, ConnectionError) as exc:
+                        raise RetryableTaskError(str(exc))
+
+                scheduler.submit(domain_id, job,
+                                 on_done=lambda tid=task_id, a=ack:
+                                 a.complete(tid))
+                submitted += 1
+            level = ack.ack_level()
+            if level > shard.transfer_ack_level:
+                shard.update_transfer_ack_level(level)
+        from ..utils import metrics as m
+        self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, m.M_TASKS_PROCESSED,
+                         submitted)
+        return submitted
+
     def process_transfer_once(self) -> int:
         """One pass over all owned shards; returns tasks processed."""
         processed = 0
@@ -155,7 +203,9 @@ class QueueProcessors:
         info = ms.execution_info
         self.stores.visibility.record_closed(
             domain_id, workflow_id, run_id,
-            close_time=self.clock.now(), close_status=info.close_status)
+            close_time=self.clock.now(), close_status=info.close_status,
+            workflow_type=info.workflow_type_name,
+            start_time=info.start_timestamp)
         # notify parent (skip for continue-as-new, task_generator.go:996-999)
         if (ms.has_parent_execution()
                 and info.close_status != CloseStatus.ContinuedAsNew):

@@ -36,6 +36,7 @@ class ShardContext:
         with self._lock:
             info = self._stores.shard.get_or_create(self.shard_id)
             prev_range = info.range_id
+            prev_owner = info.owner
             info.range_id += 1
             info.owner = self.owner
             self._stores.shard.update(info, expected_range_id=prev_range)
@@ -43,6 +44,11 @@ class ShardContext:
             self._next_task_id = info.range_id * RANGE_SIZE
             self._max_task_id = (info.range_id + 1) * RANGE_SIZE
             self._closed = False
+        from ..utils.log import DEFAULT_LOGGER
+        DEFAULT_LOGGER.info("shard acquired", component="shard",
+                            shard_id=self.shard_id, owner=self.owner,
+                            previous_owner=prev_owner or "<none>",
+                            range_id=info.range_id)
 
     def _renew_range_locked(self) -> None:
         """Fresh task-ID block for the CURRENT owner: the CAS is against our

@@ -70,6 +70,27 @@ class EchoDecider:
 
 
 @dataclass
+class ResilientEchoDecider:
+    """echo under fault injection: RESCHEDULES the activity when an
+    attempt times out (a lost worker respond surfaces as
+    ActivityTaskTimedOut) — the shape a production workflow takes in a
+    lossy cluster, and what the concurrency/fault property tests drive."""
+
+    task_list: str
+
+    def decide(self, history: List[HistoryEvent]) -> List[Decision]:
+        if _count(history, EventType.ActivityTaskCompleted) >= 1:
+            return [_complete()]
+        live = _count(history, EventType.ActivityTaskScheduled) - (
+            _count(history, EventType.ActivityTaskTimedOut)
+            + _count(history, EventType.ActivityTaskFailed)
+            + _count(history, EventType.ActivityTaskCanceled))
+        if live > 0:
+            return []
+        return [_activity("echo", self.task_list)]
+
+
+@dataclass
 class SignalDecider:
     """canary signal: wait for N signals, then complete."""
 

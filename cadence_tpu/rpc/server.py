@@ -122,6 +122,11 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                                           router=self.route,
                                           metrics=self.metrics,
                                           config=self.config)
+        # the production pump is the N-worker pool (per-domain fairness,
+        # redispatch, contiguous acks — engine/tasks.py); store round-trips
+        # are I/O the workers overlap
+        from ..engine.tasks import TaskScheduler
+        self.scheduler = TaskScheduler(num_workers=4)
         self._stop = threading.Event()
         self._beat_thread = threading.Thread(target=self._beat_loop,
                                              daemon=True)
@@ -184,7 +189,7 @@ class ServiceHost(socketserver.ThreadingTCPServer):
     def _pump_loop(self) -> None:
         while not self._stop.wait(self._pump_interval):
             try:
-                self.processors.process_transfer_once()
+                self.processors.process_transfer_concurrent(self.scheduler)
                 self.processors.process_timers_once()
             except Exception:
                 continue  # shard moved mid-pump etc.; next tick retries

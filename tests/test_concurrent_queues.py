@@ -1,0 +1,236 @@
+"""Concurrent queue processing (VERDICT r3 ask #6): worker pools with
+per-domain fairness, redispatch, and contiguous-prefix ack correctness.
+
+Reference: common/task/parallelTaskProcessor.go,
+weightedRoundRobinTaskScheduler.go, service/history/task/redispatcher.go,
+queue ack-level semantics (queue/interface.go).
+"""
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus
+from cadence_tpu.engine.faults import FaultInjector, inject_faults
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.engine.tasks import (
+    AckManager,
+    RetryableTaskError,
+    TaskScheduler,
+)
+from cadence_tpu.models.deciders import EchoDecider, ResilientEchoDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "cq-domain"
+TL = "cq-tl"
+
+
+class TestAckManager:
+    def test_contiguous_prefix_only(self):
+        ack = AckManager(0)
+        for tid in (10, 11, 12, 13):
+            assert ack.register(tid)
+        ack.complete(12)
+        ack.complete(13)
+        assert ack.ack_level() == 0  # 10 and 11 still outstanding
+        ack.complete(10)
+        assert ack.ack_level() == 10  # 11 still blocks 12/13
+        ack.complete(11)
+        assert ack.ack_level() == 13
+
+    def test_register_dedups_inflight_and_acked(self):
+        ack = AckManager(0)
+        assert ack.register(5)
+        assert not ack.register(5)       # in flight
+        ack.complete(5)
+        assert ack.ack_level() == 5
+        assert not ack.register(5)       # below the level
+        assert not ack.register(3)
+        assert ack.register(6)
+        # completed-but-blocked ids must not re-register either
+        assert ack.register(7)
+        ack.complete(7)
+        assert not ack.register(7)       # blocked behind 6, still tracked
+
+
+class TestTaskScheduler:
+    def test_round_robin_fairness_across_keys(self):
+        sched = TaskScheduler(num_workers=1)
+        order = []
+        gate = threading.Event()
+        sched.submit("hot", lambda: (gate.wait(5), order.append("hot-0")))
+        for i in range(1, 4):
+            sched.submit("hot", lambda i=i: order.append(f"hot-{i}"))
+        sched.submit("cold", lambda: order.append("cold-0"))
+        gate.set()
+        assert sched.drain()
+        sched.stop()
+        # the cold domain's single task is NOT starved behind the hot
+        # domain's backlog (weighted round-robin contract)
+        assert order.index("cold-0") <= 2
+
+    def test_redispatch_then_success(self):
+        sched = TaskScheduler(num_workers=2, max_attempts=3)
+        runs = []
+        done = threading.Event()
+
+        def flaky():
+            runs.append(1)
+            if len(runs) < 3:
+                raise RetryableTaskError("transient")
+
+        sched.submit("d", flaky, on_done=done.set)
+        assert sched.drain()
+        sched.stop()
+        assert len(runs) == 3 and done.is_set()
+        assert sched.dead == []
+
+    def test_poison_task_lands_in_dead_list_and_completes_ack(self):
+        sched = TaskScheduler(num_workers=1, max_attempts=2)
+        done = threading.Event()
+
+        def poison():
+            raise RetryableTaskError("always")
+
+        sched.submit("d", poison, on_done=done.set)
+        assert sched.drain()
+        sched.stop()
+        assert len(sched.dead) == 1
+        assert done.is_set()  # the ack completes — poison never wedges it
+
+    def test_throughput_scales_with_workers(self):
+        """I/O-shaped tasks (sleeps standing in for store/RPC round-trips)
+        must overlap: 4 workers beat 1 worker by >=2x — the active-path
+        scaling figure ask #6 demands."""
+        def run(workers: int) -> float:
+            sched = TaskScheduler(num_workers=workers)
+            t0 = time.perf_counter()
+            for i in range(24):
+                sched.submit(f"dom-{i % 4}", lambda: time.sleep(0.02))
+            assert sched.drain()
+            sched.stop()
+            return time.perf_counter() - t0
+
+        t1, t4 = run(1), run(4)
+        assert t4 * 2 < t1, f"1 worker {t1:.3f}s vs 4 workers {t4:.3f}s"
+
+
+class TestConcurrentPump:
+    def _drain_concurrent(self, box, poller, sched, rounds=200):
+        for _ in range(rounds):
+            submitted = 0
+            for p in box.processors:
+                submitted += p.process_transfer_concurrent(sched)
+                p.process_timers_once()
+            sched.drain()
+            progressed = submitted > 0
+            while poller.poll_and_decide_once():
+                progressed = True
+            while poller.poll_and_run_activity_once():
+                progressed = True
+            if not progressed and box.matching.backlog() == 0:
+                return
+        raise RuntimeError("did not drain")
+
+    def test_fleet_completes_under_concurrency(self):
+        box = Onebox(num_hosts=2, num_shards=8)
+        box.frontend.register_domain(DOMAIN)
+        deciders = {}
+        for i in range(12):
+            wf = f"wf-cc-{i}"
+            box.frontend.start_workflow_execution(DOMAIN, wf, "echo", TL)
+            deciders[wf] = EchoDecider(TL)
+        sched = TaskScheduler(num_workers=4)
+        poller = TaskPoller(box, DOMAIN, TL, deciders)
+        self._drain_concurrent(box, poller, sched)
+        sched.stop()
+        assert sched.dead == []
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for i in range(12):
+            run = box.stores.execution.get_current_run_id(domain_id,
+                                                          f"wf-cc-{i}")
+            ms = box.stores.execution.get_workflow(domain_id, f"wf-cc-{i}", run)
+            assert ms.execution_info.close_status == CloseStatus.Completed
+        assert box.tpu.verify_all().ok
+
+    def test_no_task_loss_or_dup_under_faults(self):
+        """The ask-#6 property test: scripted + random store faults while a
+        4-worker pool drains the queues — every workflow completes exactly
+        once (no loss: all close; no dup: exactly one Completed close event
+        per history), acks never skip a straggler, and the device verifies
+        the whole cluster."""
+        from cadence_tpu.core.enums import EventType
+
+        injector = FaultInjector(rate=0.05, seed=11)
+        box = Onebox(num_hosts=1, num_shards=4)
+        inject_faults(box.stores, injector,
+                      names=("execution", "shard_tasks"))
+        box.frontend.register_domain(DOMAIN)
+        from cadence_tpu.engine.faults import TransientStoreError
+        from cadence_tpu.engine.persistence import WorkflowAlreadyStartedError
+
+        deciders = {}
+        for i in range(8):
+            wf = f"wf-f-{i}"
+            for _ in range(8):  # client retry tier, as the reference wraps
+                try:
+                    box.frontend.start_workflow_execution(DOMAIN, wf,
+                                                          "echo", TL)
+                    break
+                except TransientStoreError:
+                    continue
+                except WorkflowAlreadyStartedError:
+                    break  # an earlier attempt's create committed
+            deciders[wf] = ResilientEchoDecider(TL)
+        sched = TaskScheduler(num_workers=4, max_attempts=8)
+        poller = TaskPoller(box, DOMAIN, TL, deciders)
+        quiet = 0
+        for _ in range(300):
+            submitted = 0
+            for p in box.processors:
+                submitted += p.process_transfer_concurrent(sched)
+                try:
+                    p.process_timers_once()
+                except TransientStoreError:
+                    pass
+            sched.drain()
+            progressed = submitted > 0
+            while True:
+                try:
+                    if not poller.poll_and_decide_once():
+                        break
+                except TransientStoreError:
+                    continue
+                progressed = True
+            while True:
+                try:
+                    if not poller.poll_and_run_activity_once():
+                        break
+                except TransientStoreError:
+                    continue
+                progressed = True
+            box.advance_time(11)
+            # a lost respond redelivers via the decision start-to-close
+            # TIMER: quiescence only counts after the clock has advanced
+            # past any pending timeout, so require consecutive quiet
+            # rounds with advances in between
+            if not progressed and box.matching.backlog() == 0:
+                quiet += 1
+                if quiet >= 3:
+                    break
+            else:
+                quiet = 0
+        sched.stop()
+        assert injector.injected > 0
+        assert sched.dead == []  # transient faults never kill a task
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for i in range(8):
+            wf = f"wf-f-{i}"
+            run = box.stores.execution.get_current_run_id(domain_id, wf)
+            ms = box.stores.execution.get_workflow(domain_id, wf, run)
+            assert ms.execution_info.close_status == CloseStatus.Completed
+            events = box.stores.history.read_events(domain_id, wf, run)
+            closes = [e for e in events if e.event_type ==
+                      EventType.WorkflowExecutionCompleted]
+            assert len(closes) == 1  # exactly-once close: no duplicates
+        assert box.tpu.verify_all().ok
