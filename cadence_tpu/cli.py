@@ -155,8 +155,32 @@ def main(argv=None) -> int:
     cs = adm.add_parser("config-set")
     cs.add_argument("--key", required=True)
     cs.add_argument("--value", required=True)
+    adm.add_parser("schema-version")
+    adm.add_parser("schema-migrate")
 
     args = parser.parse_args(argv)
+    # schema tools run BEFORE cluster recovery (the cassandra/sql-tool
+    # split: schema commands must work on logs recovery would refuse)
+    if args.group == "admin" and args.cmd in ("schema-version",
+                                              "schema-migrate"):
+        from .engine.durability import (
+            WAL_VERSION,
+            DurableLog,
+            migrate_wal_file,
+            wal_version,
+        )
+        if args.cmd == "schema-version":
+            current = (wal_version(DurableLog.read_all(args.wal))
+                       if os.path.exists(args.wal) else None)
+            _emit({"wal": args.wal, "version": current,
+                   "binary_version": WAL_VERSION})
+        else:
+            if not os.path.exists(args.wal):
+                _emit({"error": f"no WAL at {args.wal}"})
+                return 1
+            before, after = migrate_wal_file(args.wal)
+            _emit({"migrated": args.wal, "from": before, "to": after})
+        return 0
     _ensure_jax_backend()
     box, _report = _build_cluster(args.wal)
     from .engine.admin import AdminHandler
@@ -234,7 +258,7 @@ def main(argv=None) -> int:
                 args.domain, args.query)})
         elif args.cmd == "batch":
             from .engine.batcher import Batcher
-            report = Batcher(box.frontend, box.clock, rps=args.rps).run(
+            report = Batcher(box.frontend, rps=args.rps).run(
                 args.domain, args.query, args.op, reason=args.reason,
                 signal_name=args.name)
             box.pump_once()

@@ -34,10 +34,8 @@ class BatchReport:
 
 
 class Batcher:
-    def __init__(self, frontend, time_source, rps: float = 50.0,
-                 logger=None) -> None:
+    def __init__(self, frontend, rps: float = 50.0, logger=None) -> None:
         self.frontend = frontend
-        self.clock = time_source
         self.rps = rps
         self.log = (logger or DEFAULT_LOGGER).with_tags(component="batcher")
 

@@ -1,0 +1,138 @@
+"""LRU caches: execution context cache + domain cache.
+
+Reference: common/cache/lru.go (bounded LRU), service/history/execution/
+cache.go:48 (per-shard workflow-context cache — the engine's hot-path
+read amortizer), and common/cache/domainCache.go (domain metadata cache
+with a refresh/notification-version contract).
+
+Correctness model (differs from a plain memoizer on purpose):
+- every EXECUTION cache entry is stamped with the store's per-key WRITE
+  VERSION; a hit revalidates the version before use, so a write from ANY
+  other path (replication passive-apply, NDC conflict resolution, admin
+  rebuild — the writers that bypass this engine) invalidates the entry
+  instead of serving a stale state. The version probe is a tiny store
+  call; the win is skipping the full mutable-state read (a network
+  round-trip + unpickle against a remote store server).
+- the DOMAIN cache revalidates against the domain store's global
+  mutation counter, so UpdateDomain/failover take effect on the next
+  transaction (the reference tolerates a refresh interval of staleness;
+  this is strictly fresher).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional, Tuple
+
+
+class LRUCache:
+    """Bounded LRU (common/cache/lru.go): get refreshes recency, put
+    evicts the least-recent entry past capacity."""
+
+    def __init__(self, max_size: int = 512) -> None:
+        self.max_size = max_size
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def put(self, key: Hashable, value: Any) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_size:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def delete(self, key: Hashable) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class ExecutionCache:
+    """Per-engine mutable-state cache (execution/cache.go analog).
+
+    Entries are (state, store write version); `load` returns a PRIVATE
+    deepcopy (the transaction mutates it freely) only when the version
+    still matches the store — any foreign write is detected, never
+    served stale. The engine's shard ownership makes it the only ACTIVE
+    writer, but passive appliers exist, hence the revalidation."""
+
+    def __init__(self, max_size: int = 512) -> None:
+        self.lru = LRUCache(max_size)
+
+    def load(self, stores, domain_id: str, workflow_id: str,
+             run_id: str):
+        key = (domain_id, workflow_id, run_id)
+        entry = self.lru.get(key)
+        if entry is None:
+            return None
+        ms, version = entry
+        current = stores.execution.get_version(domain_id, workflow_id, run_id)
+        if current != version:
+            self.lru.delete(key)
+            return None
+        return copy.deepcopy(ms)
+
+    def store(self, domain_id: str, workflow_id: str, run_id: str,
+              ms, version: int) -> None:
+        self.lru.put((domain_id, workflow_id, run_id),
+                     (copy.deepcopy(ms), version))
+
+    def invalidate(self, domain_id: str, workflow_id: str,
+                   run_id: str) -> None:
+        self.lru.delete((domain_id, workflow_id, run_id))
+
+
+class DomainCache:
+    """Domain metadata cache (common/cache/domainCache.go): revalidates
+    against the store's mutation counter so updates/failovers surface on
+    the next read."""
+
+    def __init__(self, max_size: int = 256) -> None:
+        self.lru = LRUCache(max_size)
+        self._store_version = -1
+        self._lock = threading.Lock()
+
+    def _revalidate(self, stores) -> None:
+        current = stores.domain.mutation_version()
+        with self._lock:
+            if current != self._store_version:
+                self.lru.clear()
+                self._store_version = current
+
+    def by_id(self, stores, domain_id: str):
+        self._revalidate(stores)
+        info = self.lru.get(("id", domain_id))
+        if info is None:
+            info = stores.domain.by_id(domain_id)
+            self.lru.put(("id", domain_id), info)
+        return info
+
+    def by_name(self, stores, name: str):
+        self._revalidate(stores)
+        info = self.lru.get(("name", name))
+        if info is None:
+            info = stores.domain.by_name(name)
+            self.lru.put(("name", name), info)
+        return info

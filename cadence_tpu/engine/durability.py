@@ -96,6 +96,89 @@ class CorruptLogError(Exception):
     """Mid-file WAL corruption (not a torn tail)."""
 
 
+# ---------------------------------------------------------------------------
+# Schema versioning + migration (the cadence-cassandra-tool/sql-tool analog:
+# versioned schema dirs + manifest.json, tools/cassandra/handler.go:47)
+# ---------------------------------------------------------------------------
+
+#: current WAL record-schema version. History: v1 = round-2 record set;
+#: v2 = domain records carry status/description/archival-uri fields.
+WAL_VERSION = 2
+
+
+def version_record() -> dict:
+    return {"t": "ver", "v": WAL_VERSION}
+
+
+class SchemaVersionError(Exception):
+    """WAL written by a NEWER schema than this binary understands —
+    refusing beats silently dropping fields (setup-schema version gate)."""
+
+
+def _migrate_1_to_2(rec: dict) -> dict:
+    """v1→v2: domain records gain status/description/archival-uri."""
+    if rec.get("t") == "d":
+        rec.setdefault("st", 0)
+        rec.setdefault("desc", "")
+        rec.setdefault("arc", "")
+    return rec
+
+
+#: from-version → record transform producing from-version+1 records
+_MIGRATIONS = {1: _migrate_1_to_2}
+
+
+def wal_version(records: List[dict]) -> int:
+    """The log's schema version: the header record, or 1 for pre-header
+    logs (version records may also appear mid-file after upgrades — the
+    LAST one wins, matching append-only semantics)."""
+    version = 1
+    for rec in records:
+        if rec.get("t") == "ver":
+            version = rec["v"]
+    return version
+
+
+def migrate_records(records: List[dict]) -> Tuple[List[dict], int]:
+    """Lift records to WAL_VERSION in memory (update-schema's versioned
+    upgrade chain); returns (records, original_version)."""
+    version = wal_version(records)
+    if version > WAL_VERSION:
+        raise SchemaVersionError(
+            f"WAL schema v{version} is newer than this binary's "
+            f"v{WAL_VERSION}; upgrade the binary, not the data")
+    original = version
+    body = [r for r in records if r.get("t") != "ver"]
+    while version < WAL_VERSION:
+        body = [_MIGRATIONS[version](dict(r)) for r in body]
+        version += 1
+    return body, original
+
+
+def migrate_wal_file(path: str) -> Tuple[int, int]:
+    """Rewrite the log at WAL_VERSION (the schema tool's update-schema):
+    atomic replace, with the version header first. Returns
+    (from_version, to_version)."""
+    records = DurableLog.read_all(path)
+    body, original = migrate_records(records)
+    tmp = path + ".migrate"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(version_record(), separators=(",", ":")) + "\n")
+        for rec in body:
+            fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())  # the rewrite touches EVERY record: a
+        # power loss must never replace an intact log with a torn one
+    os.replace(tmp, path)
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)  # commit the rename itself
+    finally:
+        os.close(dir_fd)
+    return original, WAL_VERSION
+
+
 # -- record constructors (shared by stores and recovery) --------------------
 
 
@@ -211,9 +294,15 @@ class RecoveryReport:
 
 
 def open_durable_stores(path: str) -> Stores:
-    """Fresh cluster bundle logging to `path` (creates/extends the log)."""
+    """Fresh cluster bundle logging to `path` (creates/extends the log);
+    new logs start with the schema-version header."""
+    import os as _os
+    fresh = not _os.path.exists(path) or _os.path.getsize(path) == 0
     stores = Stores()
-    stores.attach_wal(DurableLog(path))
+    wal = DurableLog(path)
+    if fresh:
+        wal.append(version_record())
+    stores.attach_wal(wal)
     return stores
 
 
@@ -238,7 +327,10 @@ def recover_stores(path: str, verify_on_device: bool = True,
     #: pointer): a run with history but no reference is an orphan tail of
     #: a start that died before its create_workflow commit point
     referenced_runs = set()
-    for rec in DurableLog.read_all(path):
+    # schema gate + in-memory migration (the setup/update-schema contract):
+    # older logs lift transparently; NEWER logs refuse
+    records, _original = migrate_records(DurableLog.read_all(path))
+    for rec in records:
         t = rec["t"]
         if t == "d":
             info = DomainInfo(

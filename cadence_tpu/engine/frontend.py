@@ -245,6 +245,8 @@ class Frontend:
                                  reason: str = "") -> str:
         """ResetWorkflowExecution (workflowHandler.go:2726): returns the new
         run ID."""
+        from .authorization import PERMISSION_WRITE
+        self._authorize("ResetWorkflowExecution", PERMISSION_WRITE, domain)
         domain_id = self.stores.domain.by_name(domain).domain_id
         return self.router(workflow_id).reset_workflow(
             domain_id, workflow_id, run_id,

@@ -88,6 +88,13 @@ class HistoryEngine:
         #: tagged structured logger (log/tag ShardID; loggerimpl.WithTags)
         self.log = DEFAULT_LOGGER.with_tags(component="history",
                                             shard_id=shard.shard_id)
+        #: execution context cache (execution/cache.go:48): skips the full
+        #: mutable-state store read on the transaction hot path, with
+        #: store-version revalidation so foreign writers (replication,
+        #: NDC, admin rebuild) are never served stale. Bounded LRU.
+        from .cache import DomainCache, ExecutionCache
+        self.execution_cache = ExecutionCache()
+        self.domain_cache = DomainCache()
         #: shared holder so a cluster can attach its replication publisher to
         #: engines created before/after wiring ({"pub": ReplicationPublisher})
         self.replication_publisher_holder: Dict[str, Any] = {"pub": None}
@@ -150,7 +157,10 @@ class HistoryEngine:
 
     def _domain_entry(self, domain_id: str) -> DomainEntry:
         try:
-            d = self.stores.domain.by_id(domain_id)
+            # DomainCache (common/cache/domainCache.go): revalidated
+            # against the store's mutation counter, so UpdateDomain and
+            # failovers surface on the next transaction
+            d = self.domain_cache.by_id(self.stores, domain_id)
             return DomainEntry(domain_id=d.domain_id, name=d.name,
                                is_active=d.is_active,
                                retention_days=d.retention_days,
@@ -162,9 +172,15 @@ class HistoryEngine:
               run_id: Optional[str] = None) -> Tuple[MutableState, int]:
         if run_id is None:
             run_id = self.stores.execution.get_current_run_id(domain_id, workflow_id)
-        ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
-        # work on a copy so a failed transaction never corrupts the store
-        ms = copy.deepcopy(ms)
+        # context cache first (execution/cache.go GetOrCreate): a hit is
+        # already a PRIVATE copy revalidated against the store version
+        ms = self.execution_cache.load(self.stores, domain_id, workflow_id,
+                                       run_id)
+        if ms is None:
+            ms = self.stores.execution.get_workflow(domain_id, workflow_id,
+                                                    run_id)
+            # work on a copy so a failed transaction never corrupts the store
+            ms = copy.deepcopy(ms)
         # refresh the domain entry: StartTransaction re-reads the failover
         # version so post-failover events carry the new version
         # (mutable_state_builder.go:3941-3947)
@@ -1524,9 +1540,19 @@ class _Txn:
         # holds its lock across the compound op and prechecks the state
         # CAS, so a concurrent writer of the same workflow fails before
         # it can clobber this transaction's committed tail.
-        self.engine.shard.commit_workflow(
-            self.ms, expected_next_event_id, self.events,
-            new_transfer, new_timer)
+        try:
+            version = self.engine.shard.commit_workflow(
+                self.ms, expected_next_event_id, self.events,
+                new_transfer, new_timer)
+        except Exception:
+            # the entry that fed this transaction may be stale (a foreign
+            # writer won) — drop it so the caller's retry reads fresh
+            self.engine.execution_cache.invalidate(
+                info.domain_id, info.workflow_id, info.run_id)
+            raise
+        self.engine.execution_cache.store(
+            info.domain_id, info.workflow_id, info.run_id, self.ms,
+            version if version is not None else 0)
         self.engine.log.debug(
             "transaction committed", domain_id=info.domain_id,
             workflow_id=info.workflow_id, run_id=info.run_id,

@@ -286,6 +286,10 @@ class ExecutionStore:
         self._executions: Dict[Tuple[str, str, str], MutableState] = {}
         #: (domain_id, workflow_id) -> CurrentExecution
         self._current: Dict[Tuple[str, str], CurrentExecution] = {}
+        #: per-key WRITE VERSION: bumped by EVERY snapshot write (active
+        #: update, passive upsert, create, delete) — the execution cache's
+        #: revalidation token (execution/cache.go staleness guard)
+        self._versions: Dict[Tuple[str, str, str], int] = {}
 
     def _check_fence(self, shard_id: int, range_id: int) -> None:
         cur = self._shard_store.get_or_create(shard_id)
@@ -309,6 +313,7 @@ class ExecutionStore:
                     f"{info.workflow_id}: run {cur.run_id} still open"
                 )
             self._executions[key] = ms
+            self._versions[key] = self._versions.get(key, 0) + 1
             self._current[cur_key] = CurrentExecution(
                 run_id=info.run_id, state=info.state, close_status=info.close_status
             )
@@ -332,6 +337,7 @@ class ExecutionStore:
                     f"{expected_next_event_id}"
                 )
             self._executions[key] = ms
+            self._versions[key] = self._versions.get(key, 0) + 1
             cur_key = (info.domain_id, info.workflow_id)
             cur = self._current.get(cur_key)
             if cur is not None and cur.run_id == info.run_id:
@@ -340,6 +346,7 @@ class ExecutionStore:
                     close_status=info.close_status,
                 )
                 self._log_current(cur_key)
+            return self._versions[key]
 
     def check_next_event_id(self, domain_id: str, workflow_id: str,
                             run_id: str, expected: int) -> None:
@@ -370,7 +377,9 @@ class ExecutionStore:
         (ndc/transaction_manager.go createAsZombie)."""
         info = ms.execution_info
         with self._lock:
-            self._executions[(info.domain_id, info.workflow_id, info.run_id)] = ms
+            key = (info.domain_id, info.workflow_id, info.run_id)
+            self._executions[key] = ms
+            self._versions[key] = self._versions.get(key, 0) + 1
             if set_current:
                 self._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
                     run_id=info.run_id, state=info.state,
@@ -422,13 +431,22 @@ class ExecutionStore:
         never deleted by retention)."""
         from ..core.enums import WorkflowState
         with self._lock:
-            existed = self._executions.pop(
-                (domain_id, workflow_id, run_id), None) is not None
+            key = (domain_id, workflow_id, run_id)
+            existed = self._executions.pop(key, None) is not None
+            if existed:
+                self._versions[key] = self._versions.get(key, 0) + 1
             cur = self._current.get((domain_id, workflow_id))
             if (cur is not None and cur.run_id == run_id
                     and cur.state == WorkflowState.Completed):
                 self._current.pop((domain_id, workflow_id), None)
             return existed
+
+    def get_version(self, domain_id: str, workflow_id: str,
+                    run_id: str) -> int:
+        """The per-key write version (cache revalidation token): cheap to
+        probe, bumped by every writer — active, passive, or admin."""
+        with self._lock:
+            return self._versions.get((domain_id, workflow_id, run_id), 0)
 
     def list_executions(self) -> List[Tuple[str, str, str]]:
         with self._lock:
@@ -540,6 +558,8 @@ class DomainStore:
         self._wal = None
         self._by_id: Dict[str, DomainInfo] = {}
         self._by_name: Dict[str, str] = {}
+        #: bumped on every mutation — the DomainCache revalidation token
+        self._mutations = 0
 
     def _log(self, info: "DomainInfo") -> None:
         if self._wal is not None:
@@ -552,6 +572,7 @@ class DomainStore:
                 raise WorkflowAlreadyStartedError(f"domain {info.name} exists")
             self._by_id[info.domain_id] = info
             self._by_name[info.name] = info.domain_id
+            self._mutations += 1
             self._log(info)
 
     def by_name(self, name: str) -> DomainInfo:
@@ -571,7 +592,12 @@ class DomainStore:
     def update(self, info: DomainInfo) -> None:
         with self._lock:
             self._by_id[info.domain_id] = info
+            self._mutations += 1
             self._log(info)
+
+    def mutation_version(self) -> int:
+        with self._lock:
+            return self._mutations
 
     def list_domains(self) -> List[DomainInfo]:
         with self._lock:

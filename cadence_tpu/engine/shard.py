@@ -130,11 +130,14 @@ class ShardContext:
             self._stores.history.append_batch(domain_id, workflow_id,
                                               run_id, events, branch=branch)
 
-    def update_workflow(self, ms: MutableState, expected_next_event_id: int) -> None:
+    def update_workflow(self, ms: MutableState,
+                        expected_next_event_id: int) -> int:
+        """Returns the store's new per-key write version (the execution
+        cache's writeback token)."""
         with self._lock:
             self._ensure_open()
             try:
-                self._stores.execution.update_workflow(
+                return self._stores.execution.update_workflow(
                     self.shard_id, self._info.range_id, ms, expected_next_event_id
                 )
             except ShardOwnershipLostError:
@@ -164,7 +167,7 @@ class ShardContext:
                                 info.run_id, events)
             self.insert_tasks(info.domain_id, info.workflow_id, info.run_id,
                               transfer, timer)
-            self.update_workflow(ms, expected_next_event_id)
+            return self.update_workflow(ms, expected_next_event_id)
 
     # -- shard task queues -------------------------------------------------
 

@@ -80,12 +80,16 @@ class TaskScheduler:
     (redispatcher.go), then lands in the dead list — counted, never
     silently dropped."""
 
-    def __init__(self, num_workers: int = 4, max_attempts: int = 3,
-                 metrics=None) -> None:
+    def __init__(self, num_workers: int = 4, max_attempts: int = 5,
+                 retry_delay: float = 0.05, metrics=None) -> None:
         from ..utils.metrics import DEFAULT_REGISTRY
         self.metrics = metrics if metrics is not None else DEFAULT_REGISTRY
         self.num_workers = num_workers
         self.max_attempts = max_attempts
+        #: base of the exponential redispatch backoff (redispatcher.go):
+        #: without a delay, a millisecond store blip would burn every
+        #: attempt back-to-back and fast-path a recoverable task to dead
+        self.retry_delay = retry_delay
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queues: Dict[str, Deque] = {}
@@ -142,14 +146,24 @@ class TaskScheduler:
                 fn()
             except RetryableTaskError:
                 if attempt + 1 >= self.max_attempts:
-                    with self._lock:
-                        self.dead.append((key, fn))
+                    # attempts exhausted with real backoff in between: DLQ
+                    # semantics — record loudly AND ack (reference moves
+                    # poison to the DLQ and advances past it)
+                    self._kill(key, fn, "retries exhausted")
                 else:
-                    self.submit(key, fn, on_done, _attempt=attempt + 1)
-                    on_done = None  # completion fires on the final outcome
+                    # exponential redispatch backoff (redispatcher.go)
+                    import time as _time
+                    _time.sleep(min(self.retry_delay * (2 ** attempt), 1.0))
+                    try:
+                        self.submit(key, fn, on_done, _attempt=attempt + 1)
+                        on_done = None  # completion fires on the final try
+                    except RuntimeError:
+                        # stopped mid-redispatch: do NOT ack — the task
+                        # must redeliver from the persisted level on
+                        # restart, and the worker must exit cleanly
+                        on_done = None
             except Exception:
-                with self._lock:
-                    self.dead.append((key, fn))
+                self._kill(key, fn, "non-retryable failure")
             finally:
                 if on_done is not None:
                     try:
@@ -159,6 +173,17 @@ class TaskScheduler:
                 with self._lock:
                     self._active -= 1
                     self._idle.notify_all()
+
+    def _kill(self, key: str, fn, why: str) -> None:
+        """Dead-letter a task: recorded, counted, logged at ERROR — and
+        the caller's on_done still fires (DLQ-with-ack: the queue moves
+        on; the dead list is the operator's replay surface)."""
+        from ..utils.log import DEFAULT_LOGGER
+        with self._lock:
+            self.dead.append((key, fn))
+        self.metrics.inc("task-scheduler", "dead-tasks")
+        DEFAULT_LOGGER.error("task dead-lettered", component="scheduler",
+                             key=key, reason=why)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until every queued task has finished (tests/pumps)."""

@@ -37,7 +37,7 @@ class TestBatcher:
                                                   "ordertype", TL)
         box.frontend.start_workflow_execution(DOMAIN, "wf-keep", "other", TL)
         box.pump_once()
-        report = Batcher(box.frontend, box.clock, rps=100).run(
+        report = Batcher(box.frontend, rps=100).run(
             DOMAIN, "WorkflowType = 'ordertype'", "terminate",
             reason="cleanup")
         assert report.total == 3 and report.succeeded == 3
@@ -62,7 +62,7 @@ class TestBatcher:
         box.frontend.terminate_workflow_execution(DOMAIN, "wf-s2")
         # the visibility record still shows open (close task not pumped) —
         # exactly the staleness the per-execution isolation exists for
-        report = Batcher(box.frontend, box.clock, rps=100).run(
+        report = Batcher(box.frontend, rps=100).run(
             DOMAIN, "WorkflowType = 'sig'", "signal", signal_name="go")
         assert report.succeeded >= 1
         assert report.total == report.succeeded + report.failed
@@ -76,9 +76,9 @@ class TestBatcher:
 
     def test_unknown_op_refused(self, box):
         with pytest.raises(ValueError):
-            Batcher(box.frontend, box.clock).run(DOMAIN, "", "explode")
+            Batcher(box.frontend).run(DOMAIN, "", "explode")
         with pytest.raises(ValueError):
-            Batcher(box.frontend, box.clock).run(DOMAIN, "", "signal")
+            Batcher(box.frontend).run(DOMAIN, "", "signal")
 
 
 class TestStructuredLogging:

@@ -1,0 +1,106 @@
+"""LRU cache tier: execution context cache + domain cache (inventory rows
+5/50; execution/cache.go:48, common/cache/lru.go, domainCache.go).
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, EventType
+from cadence_tpu.engine.cache import DomainCache, ExecutionCache, LRUCache
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import EchoDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "cache-domain"
+TL = "cache-tl"
+
+
+class TestLRU:
+    def test_bounded_eviction_lru_order(self):
+        lru = LRUCache(max_size=2)
+        lru.put("a", 1)
+        lru.put("b", 2)
+        assert lru.get("a") == 1  # refresh recency: b is now LRU
+        lru.put("c", 3)
+        assert lru.get("b") is None and lru.evictions == 1
+        assert lru.get("a") == 1 and lru.get("c") == 3
+
+    def test_delete_and_clear(self):
+        lru = LRUCache(4)
+        lru.put("x", 1)
+        lru.delete("x")
+        assert lru.get("x") is None
+        lru.put("y", 2)
+        lru.clear()
+        assert len(lru) == 0
+
+
+class TestExecutionCache:
+    def test_foreign_writer_invalidates(self):
+        """A write that bypasses the engine (replication passive apply,
+        admin rebuild) must never be served stale: the store version
+        revalidation detects it."""
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-c", "t", TL)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        run = box.stores.execution.get_current_run_id(domain_id, "wf-c")
+        engine = box.route("wf-c")
+        # prime the cache through a real transaction
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-c", "s1")
+        assert engine.execution_cache.load(box.stores, domain_id, "wf-c",
+                                           run) is not None
+        # a FOREIGN writer upserts the snapshot directly (passive path)
+        import copy
+        foreign = copy.deepcopy(box.stores.execution.get_workflow(
+            domain_id, "wf-c", run))
+        foreign.execution_info.signal_count = 99
+        box.stores.execution.upsert_workflow(foreign)
+        # the cache detects the version bump and refuses the stale entry
+        assert engine.execution_cache.load(box.stores, domain_id, "wf-c",
+                                           run) is None
+        # and the next transaction sees the foreign write
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-c", "s2")
+        ms = box.stores.execution.get_workflow(domain_id, "wf-c", run)
+        assert ms.execution_info.signal_count == 100
+
+    def test_hot_path_hits_and_workflows_stay_correct(self):
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain(DOMAIN)
+        for i in range(4):
+            box.frontend.start_workflow_execution(DOMAIN, f"wf-h-{i}", "t", TL)
+        TaskPoller(box, DOMAIN, TL,
+                   {f"wf-h-{i}": EchoDecider(TL) for i in range(4)}).drain()
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for i in range(4):
+            run = box.stores.execution.get_current_run_id(domain_id,
+                                                          f"wf-h-{i}")
+            ms = box.stores.execution.get_workflow(domain_id, f"wf-h-{i}", run)
+            assert ms.execution_info.close_status == CloseStatus.Completed
+        hits = sum(c.execution_cache.lru.hits
+                   for ctrl in box.controllers.values()
+                   for c in ctrl._engines.values())
+        assert hits > 0  # the hot path actually used the cache
+        assert box.tpu.verify_all().ok
+
+
+class TestDomainCache:
+    def test_update_visible_on_next_read(self):
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain(DOMAIN, retention_days=1)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-d", "t", TL)
+        engine = box.route("wf-d")
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        assert engine._domain_entry(domain_id).retention_days == 1
+        box.frontend.update_domain(DOMAIN, retention_days=7)
+        # mutation-counter revalidation: no TTL staleness window
+        assert engine._domain_entry(domain_id).retention_days == 7
+
+    def test_failover_version_flows_through_cache(self):
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain(DOMAIN,
+                                     clusters=("primary", "standby"))
+        box.frontend.update_domain(DOMAIN, active_cluster="standby")
+        box.frontend.update_domain(DOMAIN, active_cluster="primary")
+        ver = box.frontend.describe_domain(DOMAIN).failover_version
+        box.frontend.start_workflow_execution(DOMAIN, "wf-v", "t", TL)
+        events = box.frontend.get_workflow_execution_history(DOMAIN, "wf-v")
+        assert events[0].version == ver
