@@ -150,6 +150,8 @@ def main(argv=None) -> int:
     scan = adm.add_parser("scan")
     scan.add_argument("--fix", action="store_true")
     adm.add_parser("scavenge")
+    wd = adm.add_parser("watchdog")
+    wd.add_argument("--fix", action="store_true")
     cg = adm.add_parser("config-get")
     cg.add_argument("--key", required=True)
     cs = adm.add_parser("config-set")
@@ -293,6 +295,11 @@ def main(argv=None) -> int:
             return 0 if report.ok else 1
         elif args.cmd == "scavenge":
             _emit({"deleted": box.scavenger.run_once()})
+        elif args.cmd == "watchdog":
+            from .engine.workers import Watchdog
+            report = Watchdog(box).run_once(fix=args.fix)
+            _emit(report)
+            return 0 if report["ok"] else 1
         elif args.cmd == "config-get":
             _emit({args.key: admin.get_dynamic_config(args.key)})
         elif args.cmd == "config-set":

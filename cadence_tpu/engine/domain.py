@@ -26,6 +26,31 @@ class DomainValidationError(Exception):
     """attrValidator rejection (BadRequestError in the reference)."""
 
 
+class DomainNotActiveError(Exception):
+    """A global domain's active-cluster API hit a PASSIVE cluster
+    (types.DomainNotActiveError): callers should retry against the
+    active cluster — or go through the cluster redirection frontend,
+    which forwards for them (engine/redirection.py)."""
+
+    def __init__(self, domain: str, active_cluster: str,
+                 current_cluster: str) -> None:
+        super().__init__(
+            f"domain {domain} is active in {active_cluster!r}, not "
+            f"{current_cluster!r}")
+        self.domain = domain
+        self.active_cluster = active_cluster
+        self.current_cluster = current_cluster
+
+
+def require_active(info, local_cluster: str) -> None:
+    """Active-cluster gate for mutating APIs on GLOBAL domains
+    (historyEngine's domain-active check). Local (single-cluster)
+    domains are always active wherever they live."""
+    if len(info.clusters) > 1 and info.active_cluster != local_cluster:
+        raise DomainNotActiveError(info.name, info.active_cluster,
+                                   local_cluster)
+
+
 def validate_retention(retention_days: int) -> None:
     if retention_days < MIN_RETENTION_DAYS:
         raise DomainValidationError(

@@ -1,0 +1,109 @@
+"""Domain-metadata replication: domain mutations flow to every cluster.
+
+Reference: common/domain/replicationTaskExecutor.go (apply
+register/update tasks on the receiving cluster), replication_queue.go
+(the DB-backed domain replication queue), and service/worker/replicator
+(the consumer). The reference transports these over Kafka; this
+framework's messaging seam is the durable store queue (the same
+reframing the history replication stream uses — one ordered,
+at-least-once topic per concern).
+
+The receiving side recomputes `is_active` from its OWN cluster name, so
+one replicated record serves every consumer (the invariant that makes a
+domain "global": same domain_id, same config, per-cluster activeness).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from .persistence import DomainInfo, EntityNotExistsError
+
+DOMAIN_REPLICATION_QUEUE = "domain-replication"
+
+
+@dataclass(frozen=True)
+class DomainReplicationTask:
+    """One domain mutation (replicator.DomainTaskAttributes analog)."""
+
+    domain_id: str
+    name: str
+    retention_days: int
+    active_cluster: str
+    clusters: Tuple[str, ...]
+    failover_version: int
+    notification_version: int
+    status: int
+    description: str
+    history_archival_uri: str
+
+    @classmethod
+    def of(cls, info: DomainInfo) -> "DomainReplicationTask":
+        return cls(domain_id=info.domain_id, name=info.name,
+                   retention_days=info.retention_days,
+                   active_cluster=info.active_cluster,
+                   clusters=tuple(info.clusters),
+                   failover_version=info.failover_version,
+                   notification_version=info.notification_version,
+                   status=info.status, description=info.description,
+                   history_archival_uri=info.history_archival_uri)
+
+
+class DomainReplicationPublisher:
+    """Active-side producer: every domain mutation enqueues a task."""
+
+    def __init__(self, stores) -> None:
+        self.stores = stores
+
+    def publish(self, info: DomainInfo) -> None:
+        self.stores.queue.enqueue(DOMAIN_REPLICATION_QUEUE,
+                                  DomainReplicationTask.of(info))
+
+
+class DomainReplicationProcessor:
+    """Receiving-side consumer (replicationTaskExecutor.Execute): apply
+    register-or-update, recomputing is_active locally; stale tasks
+    (older notification version) are skipped — the queue is
+    at-least-once and replays after recovery."""
+
+    def __init__(self, source_queue_stores, target_stores,
+                 local_cluster: str) -> None:
+        self.source = source_queue_stores
+        self.target = target_stores
+        self.local_cluster = local_cluster
+        self._cursor = 0
+
+    def process_once(self) -> int:
+        """Drain the stream to the tail (all pages); returns tasks
+        APPLIED (stale replays advance the cursor without counting)."""
+        applied = 0
+        while True:
+            items = self.source.queue.read(DOMAIN_REPLICATION_QUEUE,
+                                           self._cursor)
+            if not items:
+                return applied
+            for index, task in items:
+                self._cursor = index + 1
+                if self._apply(task):
+                    applied += 1
+
+    def _apply(self, task: DomainReplicationTask) -> bool:
+        info = DomainInfo(
+            domain_id=task.domain_id, name=task.name,
+            retention_days=task.retention_days,
+            is_active=task.active_cluster == self.local_cluster,
+            active_cluster=task.active_cluster,
+            clusters=tuple(task.clusters),
+            failover_version=task.failover_version,
+            notification_version=task.notification_version,
+            status=task.status, description=task.description,
+            history_archival_uri=task.history_archival_uri)
+        try:
+            existing = self.target.domain.by_id(task.domain_id)
+        except EntityNotExistsError:
+            self.target.domain.register(info)
+            return True
+        if existing.notification_version >= task.notification_version:
+            return False  # stale replay (at-least-once queue)
+        self.target.domain.update(info)
+        return True

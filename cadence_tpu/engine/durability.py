@@ -235,6 +235,7 @@ def current_run_record(domain_id: str, workflow_id: str,
 
 
 def queue_record(queue: str, payload) -> dict:
+    from .domainrepl import DomainReplicationTask
     from .replication import DLQEntry, ReplicationTask
     if isinstance(payload, ReplicationTask):
         body = _repl_task_dict(payload)
@@ -242,6 +243,10 @@ def queue_record(queue: str, payload) -> dict:
     elif isinstance(payload, DLQEntry):
         body = {"task": _repl_task_dict(payload.task), "err": payload.error}
         kind = "dlq"
+    elif isinstance(payload, DomainReplicationTask):
+        from dataclasses import asdict
+        body = dict(asdict(payload), clusters=list(payload.clusters))
+        kind = "domain"
     else:
         raise TypeError(
             f"queue payload {type(payload).__name__} is not durable — "
@@ -379,6 +384,11 @@ def recover_stores(path: str, verify_on_device: bool = True,
         elif t == "q":
             if rec["k"] == "task":
                 stores.queue.enqueue(rec["q"], _repl_task_from(rec["p"]))
+            elif rec["k"] == "domain":
+                from .domainrepl import DomainReplicationTask
+                body = dict(rec["p"])
+                body["clusters"] = tuple(body["clusters"])
+                stores.queue.enqueue(rec["q"], DomainReplicationTask(**body))
             else:
                 from .replication import DLQEntry
                 stores.queue.enqueue(rec["q"], DLQEntry(

@@ -77,6 +77,9 @@ class Frontend:
         #: against (cluster/metadata.go); multi-cluster wiring replaces it
         from .cluster import ClusterMetadata
         self.cluster_meta = ClusterMetadata()
+        #: set by multi-cluster wiring: domain mutations stream to peers
+        #: (common/domain/replication_queue.go producer seam)
+        self.domain_replication_publisher = None
         self.config = config if config is not None else DynamicConfig()
         self.metrics = metrics if metrics is not None else m.DEFAULT_REGISTRY
         clock = time_source if time_source is not None else RealTimeSource()
@@ -119,10 +122,16 @@ class Frontend:
         if retention_days <= 0:
             retention_days = int(self.config.get(KEY_RETENTION_DAYS_DEFAULT))
         domain_id = domain_id or str(uuid.uuid4())
-        self.stores.domain.register(DomainInfo(
+        info = DomainInfo(
             domain_id=domain_id, name=name, retention_days=retention_days,
             is_active=is_active, active_cluster=active_cluster,
-            clusters=tuple(clusters), failover_version=failover_version))
+            clusters=tuple(clusters), failover_version=failover_version)
+        self.stores.domain.register(info)
+        # global domains replicate their REGISTRATION too (the processor's
+        # register arm) — peers must not wait for the first update
+        if self.domain_replication_publisher is not None and len(
+                info.clusters) > 1:
+            self.domain_replication_publisher.publish(info)
         return domain_id
 
     def describe_domain(self, name: str) -> DomainInfo:
@@ -139,20 +148,28 @@ class Frontend:
         from .authorization import PERMISSION_ADMIN
         self._authorize("UpdateDomain", PERMISSION_ADMIN, name)
         from .domain import update_domain
-        return update_domain(self.stores, name,
+        info = update_domain(self.stores, name,
                              local_cluster=self.cluster_name,
                              meta=self.cluster_meta,
                              retention_days=retention_days,
                              description=description, clusters=clusters,
                              active_cluster=active_cluster,
                              history_archival_uri=history_archival_uri)
+        if self.domain_replication_publisher is not None and len(
+                info.clusters) > 1:
+            self.domain_replication_publisher.publish(info)
+        return info
 
     def deprecate_domain(self, name: str) -> DomainInfo:
         """DeprecateDomain: rejects new starts, running workflows finish."""
         from .authorization import PERMISSION_ADMIN
         self._authorize("DeprecateDomain", PERMISSION_ADMIN, name)
         from .domain import deprecate_domain
-        return deprecate_domain(self.stores, name)
+        info = deprecate_domain(self.stores, name)
+        if self.domain_replication_publisher is not None and len(
+                info.clusters) > 1:
+            self.domain_replication_publisher.publish(info)
+        return info
 
     def list_domains(self) -> List[DomainInfo]:
         return self.stores.domain.list_domains()
@@ -172,9 +189,10 @@ class Frontend:
         self._authorize("StartWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_START)
         self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
-        from .domain import require_startable
+        from .domain import require_active, require_startable
         info = self.stores.domain.by_name(domain)
         require_startable(info)
+        require_active(info, self.cluster_name)
         domain_id = info.domain_id
         engine = self.router(workflow_id)
         return engine.start_workflow(
@@ -194,8 +212,10 @@ class Frontend:
         from .authorization import PERMISSION_WRITE
         self._authorize("SignalWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
-        domain_id = self.stores.domain.by_name(domain).domain_id
-        self.router(workflow_id).signal_workflow(domain_id, workflow_id,
+        from .domain import require_active
+        info = self.stores.domain.by_name(domain)
+        require_active(info, self.cluster_name)
+        self.router(workflow_id).signal_workflow(info.domain_id, workflow_id,
                                                  signal_name, run_id)
 
     def signal_with_start_workflow_execution(
@@ -212,9 +232,10 @@ class Frontend:
         self._authorize("SignalWithStartWorkflowExecution", PERMISSION_WRITE,
                         domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
-        from .domain import require_startable
+        from .domain import require_active, require_startable
         info = self.stores.domain.by_name(domain)
         require_startable(info)
+        require_active(info, self.cluster_name)
         return self.router(workflow_id).signal_with_start_workflow(
             info.domain_id, workflow_id, signal_name, workflow_type,
             task_list, execution_timeout=execution_timeout,
@@ -224,20 +245,25 @@ class Frontend:
     def request_cancel_workflow_execution(self, domain: str, workflow_id: str,
                                           run_id: Optional[str] = None) -> None:
         from .authorization import PERMISSION_WRITE
+        from .domain import require_active
         self._authorize("RequestCancelWorkflowExecution", PERMISSION_WRITE,
                         domain)
-        domain_id = self.stores.domain.by_name(domain).domain_id
-        self.router(workflow_id).request_cancel_workflow(domain_id, workflow_id,
-                                                         run_id)
+        info = self.stores.domain.by_name(domain)
+        require_active(info, self.cluster_name)
+        self.router(workflow_id).request_cancel_workflow(info.domain_id,
+                                                         workflow_id, run_id)
 
     def terminate_workflow_execution(self, domain: str, workflow_id: str,
                                      run_id: Optional[str] = None,
                                      reason: str = "") -> None:
         from .authorization import PERMISSION_WRITE
+        from .domain import require_active
         self._authorize("TerminateWorkflowExecution", PERMISSION_WRITE, domain)
-        domain_id = self.stores.domain.by_name(domain).domain_id
-        self.router(workflow_id).terminate_workflow(domain_id, workflow_id,
-                                                    run_id, reason)
+        info = self.stores.domain.by_name(domain)
+        require_active(info, self.cluster_name)
+        self.router(workflow_id).terminate_workflow(info.domain_id,
+                                                    workflow_id, run_id,
+                                                    reason)
 
     def reset_workflow_execution(self, domain: str, workflow_id: str,
                                  decision_finish_event_id: int,
@@ -246,8 +272,11 @@ class Frontend:
         """ResetWorkflowExecution (workflowHandler.go:2726): returns the new
         run ID."""
         from .authorization import PERMISSION_WRITE
+        from .domain import require_active
         self._authorize("ResetWorkflowExecution", PERMISSION_WRITE, domain)
-        domain_id = self.stores.domain.by_name(domain).domain_id
+        info = self.stores.domain.by_name(domain)
+        require_active(info, self.cluster_name)
+        domain_id = info.domain_id
         return self.router(workflow_id).reset_workflow(
             domain_id, workflow_id, run_id,
             decision_finish_event_id=decision_finish_event_id, reason=reason)

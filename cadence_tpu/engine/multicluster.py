@@ -59,6 +59,23 @@ class ReplicatedClusters:
             self.active.stores,
             source_history_reader=self._read_standby_history)
         self.reverse_processor.metrics = self.active.metrics
+        # domain-metadata replication (common/domain/replication_queue.go
+        # + worker/replicator): active-side domain mutations stream to the
+        # standby, which recomputes is_active from its own cluster name
+        from .domainrepl import (
+            DomainReplicationProcessor,
+            DomainReplicationPublisher,
+        )
+        self.domain_publisher = DomainReplicationPublisher(self.active.stores)
+        self.active.frontend.domain_replication_publisher = self.domain_publisher
+        self.domain_processor = DomainReplicationProcessor(
+            self.active.stores, self.standby.stores, "standby")
+        self.reverse_domain_publisher = DomainReplicationPublisher(
+            self.standby.stores)
+        self.standby.frontend.domain_replication_publisher = (
+            self.reverse_domain_publisher)
+        self.reverse_domain_processor = DomainReplicationProcessor(
+            self.standby.stores, self.active.stores, "primary")
 
     def _read_source_history(self, domain_id: str, workflow_id: str,
                              run_id: str, from_event_id: int,
@@ -90,13 +107,19 @@ class ReplicatedClusters:
         return domain_id
 
     def replicate(self) -> int:
-        """Drain the replication stream into the standby."""
-        total = 0
+        """Drain the replication stream into the standby (history AND
+        domain metadata)."""
+        total = self.domain_processor.process_once()
         while True:
             n = self.processor.process_once()
             total += n
             if n == 0:
                 return total
+
+    def replicate_domains(self) -> int:
+        """Drain only the domain-metadata stream (both directions)."""
+        return (self.domain_processor.process_once()
+                + self.reverse_domain_processor.process_once())
 
     def replicate_reverse(self) -> int:
         """Drain the standby's outbound stream into the active cluster."""
@@ -119,6 +142,9 @@ class ReplicatedClusters:
         d.failover_version = new_version
         d.active_cluster = "standby"
         d.is_active = True
+        # notification-version ordering: a queued pre-promotion domain
+        # task must never replay OVER this write on a receiving cluster
+        d.notification_version += 1
         self.standby.stores.domain.update(d)
         _refresh_domain_tasks(self.standby, domain_name)
         return new_version
@@ -129,14 +155,34 @@ class ReplicatedClusters:
         resolution runs on both sides."""
         winner = (self.standby if active_cluster == "standby"
                   else self.active).stores.domain.by_name(domain_name)
+        winner_nv = max(
+            self.active.stores.domain.by_name(domain_name).notification_version,
+            self.standby.stores.domain.by_name(domain_name).notification_version,
+        ) + 1
         for box in (self.active, self.standby):
             d = box.stores.domain.by_name(domain_name)
             d.failover_version = winner.failover_version
             d.active_cluster = active_cluster
             d.is_active = box.cluster_name == active_cluster
+            d.notification_version = winner_nv
             box.stores.domain.update(d)
         self.replicate()
         self.replicate_reverse()
+
+    def redirecting_frontend(self, cluster: str,
+                             policy: str = "selected-apis-forwarding"):
+        """The cluster-redirection wrapper for one side's frontend
+        (clusterRedirectionHandler.go): global domains' active APIs
+        forward to the active cluster."""
+        from .redirection import ClusterRedirectionFrontend
+        if cluster == "primary":
+            local, remote = self.active.frontend, self.standby.frontend
+            remotes = {"standby": remote}
+        else:
+            local, remote = self.standby.frontend, self.active.frontend
+            remotes = {"primary": remote}
+        return ClusterRedirectionFrontend(local, remotes, cluster,
+                                          policy=policy)
 
     def failover(self, domain_name: str, to_cluster: str = "standby") -> int:
         """Graceful failover: bump the domain failover version into the
@@ -145,11 +191,17 @@ class ReplicatedClusters:
         replicator). Returns the new failover version."""
         current = self.active.stores.domain.by_name(domain_name).failover_version
         new_version = self.meta.next_failover_version(to_cluster, current)
+        next_nv = max(
+            self.active.stores.domain.by_name(domain_name).notification_version,
+            self.standby.stores.domain.by_name(domain_name).notification_version,
+        ) + 1
         for box in (self.active, self.standby):
             d = box.stores.domain.by_name(domain_name)
             d.failover_version = new_version
             d.active_cluster = to_cluster
             d.is_active = box.cluster_name == to_cluster
+            # ahead of any queued pre-failover domain-replication task
+            d.notification_version = next_nv
             box.stores.domain.update(d)
         # Standby promotion: the replicated state carries no tasks
         # (replication.py discards them), so every open workflow on the

@@ -96,12 +96,24 @@ class ScanReport:
     orphan_pointers: List[Tuple[str, str, str]] = field(default_factory=list)
     missing_history: List[Tuple[str, str, str]] = field(default_factory=list)
     state_divergent: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: OPEN runs holding no current pointer (invariant/openCurrentExecution
+    #: .go): zombies are expected on a standby, orphans are not — both
+    #: reported, neither dispatched
+    open_without_pointer: List[Tuple[str, str, str]] = field(
+        default_factory=list)
+    #: pending activities/timers whose deadline math is inconsistent
+    #: (invariant/timerInvalid.go analog): schedule ids beyond the
+    #: history's next-event-id can never resolve
+    invalid_pending: List[Tuple[str, str, str]] = field(default_factory=list)
     fixed: int = 0
+    #: the device bulk-verify result backing state_divergent (one pass,
+    #: shared with the watchdog rollup)
+    verify: object = None
 
     @property
     def ok(self) -> bool:
         return not (self.orphan_pointers or self.missing_history
-                    or self.state_divergent)
+                    or self.state_divergent or self.invalid_pending)
 
 
 class ExecutionScanner:
@@ -138,16 +150,77 @@ class ExecutionScanner:
                 report.missing_history.append(key)
             else:
                 with_history.append(key)
+        # per-key invariants off ONE state fetch: open run ⇒ current
+        # pointer (openCurrentExecution.go; zombies visible, never
+        # silently resident) and pending items reference events that
+        # exist (timerInvalid.go analog — an entry past the history tail
+        # can never resolve)
+        from ..core.enums import WorkflowState
+        for key in keys:
+            ms = self.stores.execution.get_workflow(*key)
+            info = ms.execution_info
+            if info.state != WorkflowState.Completed:
+                try:
+                    is_current = (self.stores.execution.get_current_run_id(
+                        key[0], key[1]) == key[2])
+                except EntityNotExistsError:
+                    is_current = False
+                if not is_current:
+                    report.open_without_pointer.append(key)
+            next_id = info.next_event_id
+            bad = any(sched >= next_id
+                      for sched in ms.pending_activity_info_ids)
+            bad = bad or any(ti.started_id >= next_id
+                             for ti in ms.pending_timer_info_ids.values())
+            if bad:
+                report.invalid_pending.append(key)
         # invariant: mutable state replays bit-exact on device (the
-        # checksum oracle as a scanner invariant, execution/checksum.go)
+        # checksum oracle as a scanner invariant, execution/checksum.go);
+        # the result rides the report so callers (watchdog) never pay a
+        # second full device pass
         if with_history:
             result = self.tpu.verify_all(with_history)
             report.state_divergent = list(result.divergent)
+            report.verify = result
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_WORKER_SCANNER, m.M_EXECUTIONS_SCANNED,
                          report.executions)
         self.metrics.inc(m.SCOPE_WORKER_SCANNER, m.M_INVARIANT_VIOLATIONS,
                          len(report.orphan_pointers)
                          + len(report.missing_history)
-                         + len(report.state_divergent))
+                         + len(report.state_divergent)
+                         + len(report.invalid_pending))
+        return report
+
+
+class Watchdog:
+    """Periodic health sweep (service/worker/watchdog + esanalyzer's
+    corrective role, folded onto this framework's invariant surface):
+    one pass = scanner invariants + device verification + retention
+    scavenge, rolled into a single report the operator (or a cron'd CLI)
+    can alert on."""
+
+    def __init__(self, box) -> None:
+        self.box = box
+
+    def run_once(self, fix: bool = False) -> dict:
+        scan = self.box.scanner.run_once(fix=fix)
+        deleted = self.box.scavenger.run_once()
+        verified = (scan.verify.verified_on_device
+                    if scan.verify is not None else 0)
+        report = {
+            "ok": scan.ok,
+            "executions": scan.executions,
+            "orphan_pointers": len(scan.orphan_pointers),
+            "missing_history": len(scan.missing_history),
+            "state_divergent": len(scan.state_divergent),
+            "open_without_pointer": len(scan.open_without_pointer),
+            "invalid_pending": len(scan.invalid_pending),
+            "verified_on_device": verified,
+            "scavenged": deleted,
+            "fixed": scan.fixed,
+        }
+        from ..utils.log import DEFAULT_LOGGER
+        (DEFAULT_LOGGER.info if report["ok"] else DEFAULT_LOGGER.error)(
+            "watchdog sweep", component="watchdog", **report)
         return report

@@ -1,0 +1,149 @@
+"""Cluster redirection + domain-metadata replication (inventory rows
+26/49/54; clusterRedirectionHandler.go, common/domain/replication_queue.go,
+service/worker/replicator).
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, WorkflowState
+from cadence_tpu.engine.domain import DomainNotActiveError
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from cadence_tpu.models.deciders import EchoDecider
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "rd-domain"
+TL = "rd-tl"
+
+
+@pytest.fixture()
+def clusters():
+    c = ReplicatedClusters(num_hosts=1, num_shards=4)
+    c.register_global_domain(DOMAIN)
+    return c
+
+
+class TestDomainNotActive:
+    def test_passive_cluster_rejects_active_apis(self, clusters):
+        with pytest.raises(DomainNotActiveError):
+            clusters.standby.frontend.start_workflow_execution(
+                DOMAIN, "wf-x", "t", TL)
+        with pytest.raises(DomainNotActiveError):
+            clusters.standby.frontend.signal_workflow_execution(
+                DOMAIN, "wf-x", "s")
+        # the ACTIVE side serves normally
+        clusters.active.frontend.start_workflow_execution(DOMAIN, "wf-x",
+                                                          "t", TL)
+
+    def test_local_domains_always_active(self, clusters):
+        clusters.standby.frontend.register_domain("local-only")
+        clusters.standby.frontend.start_workflow_execution(
+            "local-only", "wf-l", "t", TL)
+
+
+class TestRedirection:
+    def test_passive_frontend_forwards_to_active(self, clusters):
+        fe = clusters.redirecting_frontend("standby")
+        fe.start_workflow_execution(DOMAIN, "wf-fwd", "echo", TL)
+        # the workflow LIVES on the active cluster
+        domain_id = clusters.active.frontend.describe_domain(DOMAIN).domain_id
+        run = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "wf-fwd")
+        assert run
+        fe.signal_workflow_execution(DOMAIN, "wf-fwd", "hello")
+        TaskPoller(clusters.active, DOMAIN, TL,
+                   {"wf-fwd": EchoDecider(TL)}).drain()
+        ms = clusters.active.stores.execution.get_workflow(domain_id,
+                                                           "wf-fwd", run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        # reads stay local (served by the wrapper's own cluster)
+        assert fe.describe_domain(DOMAIN).name == DOMAIN
+
+    def test_noop_policy_surfaces_not_active(self, clusters):
+        fe = clusters.redirecting_frontend("standby", policy="noop")
+        with pytest.raises(DomainNotActiveError):
+            fe.start_workflow_execution(DOMAIN, "wf-noop", "t", TL)
+
+    def test_forwarding_flips_after_failover(self, clusters):
+        clusters.failover(DOMAIN, to_cluster="standby")
+        clusters.replicate_domains()
+        fe_standby = clusters.redirecting_frontend("standby")
+        fe_active = clusters.redirecting_frontend("primary")
+        # the standby now serves locally...
+        fe_standby.start_workflow_execution(DOMAIN, "wf-after", "t", TL)
+        domain_id = clusters.standby.frontend.describe_domain(
+            DOMAIN).domain_id
+        assert clusters.standby.stores.execution.get_current_run_id(
+            domain_id, "wf-after")
+        # ...and the old active FORWARDS to it
+        fe_active.signal_workflow_execution(DOMAIN, "wf-after", "sig")
+        ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "wf-after",
+            clusters.standby.stores.execution.get_current_run_id(
+                domain_id, "wf-after"))
+        assert ms.execution_info.signal_count == 1
+
+
+class TestDomainReplication:
+    def test_update_streams_to_standby(self, clusters):
+        clusters.active.frontend.update_domain(
+            DOMAIN, retention_days=9, description="replicated")
+        assert clusters.replicate_domains() >= 1
+        info = clusters.standby.frontend.describe_domain(DOMAIN)
+        assert info.retention_days == 9
+        assert info.description == "replicated"
+        assert not info.is_active  # recomputed locally on the standby
+
+    def test_failover_via_update_replicates_activeness(self, clusters):
+        clusters.active.frontend.update_domain(DOMAIN,
+                                               active_cluster="standby")
+        clusters.replicate_domains()
+        standby_info = clusters.standby.frontend.describe_domain(DOMAIN)
+        assert standby_info.active_cluster == "standby"
+        assert standby_info.is_active  # the standby knows it is active now
+        # active-cluster APIs now serve on the standby
+        clusters.standby.frontend.start_workflow_execution(
+            DOMAIN, "wf-failover", "t", TL)
+
+    def test_stale_replay_is_skipped(self, clusters):
+        clusters.active.frontend.update_domain(DOMAIN, retention_days=5)
+        assert clusters.replicate_domains() >= 1
+        # replaying the SAME queue from scratch must not regress
+        from cadence_tpu.engine.domainrepl import DomainReplicationProcessor
+        replayer = DomainReplicationProcessor(clusters.active.stores,
+                                              clusters.standby.stores,
+                                              "standby")
+        assert replayer.process_once() == 0  # all stale: notification ver
+        assert clusters.standby.frontend.describe_domain(
+            DOMAIN).retention_days == 5
+
+    def test_deprecate_streams_to_standby(self, clusters):
+        clusters.active.frontend.deprecate_domain(DOMAIN)
+        clusters.replicate_domains()
+        from cadence_tpu.engine.persistence import DOMAIN_STATUS_DEPRECATED
+        assert clusters.standby.frontend.describe_domain(
+            DOMAIN).status == DOMAIN_STATUS_DEPRECATED
+
+    def test_global_registration_replicates(self):
+        """A global domain registered through the active frontend exists
+        on the standby after one drain — no manual dual registration."""
+        c = ReplicatedClusters(num_hosts=1, num_shards=4)
+        c.active.frontend.register_domain(
+            "fresh-global", clusters=("primary", "standby"),
+            active_cluster="primary",
+            failover_version=c.meta.initial_failover_version("primary"))
+        assert c.replicate_domains() >= 1
+        info = c.standby.frontend.describe_domain("fresh-global")
+        assert info.clusters == ("primary", "standby")
+        assert not info.is_active
+
+    def test_update_then_failover_never_reverts(self):
+        """A queued pre-failover update must not replay OVER the failover
+        on the receiving side (code-review r4 #2)."""
+        c = ReplicatedClusters(num_hosts=1, num_shards=4)
+        c.register_global_domain(DOMAIN)
+        c.active.frontend.update_domain(DOMAIN, description="before")
+        # failover WITHOUT draining the queued update first
+        c.failover(DOMAIN, to_cluster="standby")
+        c.replicate_domains()
+        info = c.standby.frontend.describe_domain(DOMAIN)
+        assert info.active_cluster == "standby"
+        assert info.is_active

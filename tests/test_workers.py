@@ -167,3 +167,76 @@ class TestScanner:
         # fixed: pointer dropped, id startable
         report2 = box.scanner.run_once()
         assert report2.ok
+
+
+class TestNewInvariantsAndWatchdog:
+    def test_open_without_pointer_reported(self):
+        """Zombie/orphan open runs surface in the scan (invariant/
+        openCurrentExecution.go) without failing it — they are expected
+        on standbys — while invalid pending items DO fail it."""
+        import copy
+
+        from cadence_tpu.engine.onebox import Onebox
+        from tests.taskpoller import TaskPoller
+        from cadence_tpu.models.deciders import EchoDecider
+
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain("wd-dom")
+        box.frontend.start_workflow_execution("wd-dom", "wf-z", "t", "wd-tl")
+        domain_id = box.frontend.describe_domain("wd-dom").domain_id
+        run = box.stores.execution.get_current_run_id(domain_id, "wf-z")
+        # forge a zombie: a second OPEN run without the current pointer
+        zombie = copy.deepcopy(box.stores.execution.get_workflow(
+            domain_id, "wf-z", run))
+        zombie.execution_info.run_id = "zombie-run"
+        box.stores.history.append_batch(
+            domain_id, "wf-z", "zombie-run",
+            box.stores.history.read_events(domain_id, "wf-z", run))
+        box.stores.execution.upsert_workflow(zombie, set_current=False)
+        report = box.scanner.run_once()
+        assert (domain_id, "wf-z", "zombie-run") in report.open_without_pointer
+        assert report.ok  # zombies don't fail the scan; corruption does
+
+    def test_invalid_pending_fails_scan(self):
+        import copy
+
+        from cadence_tpu.engine.onebox import Onebox
+
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain("wd-dom")
+        box.frontend.start_workflow_execution("wd-dom", "wf-bad", "t", "wd-tl")
+        domain_id = box.frontend.describe_domain("wd-dom").domain_id
+        run = box.stores.execution.get_current_run_id(domain_id, "wf-bad")
+        broken = copy.deepcopy(box.stores.execution.get_workflow(
+            domain_id, "wf-bad", run))
+        # a pending activity whose schedule id is beyond the history tail
+        import dataclasses
+        from cadence_tpu.oracle.mutable_state import ActivityInfo
+        fields = {f.name: 0 for f in dataclasses.fields(ActivityInfo)}
+        fields.update(schedule_id=999, activity_id="ghost", domain_id="",
+                      task_list="", started_id=-23)
+        for f in dataclasses.fields(ActivityInfo):
+            if f.type == "str":
+                fields.setdefault(f.name, "")
+                if not isinstance(fields[f.name], str):
+                    fields[f.name] = ""
+        broken.pending_activity_info_ids[999] = ActivityInfo(**fields)
+        box.stores.execution.upsert_workflow(broken)
+        report = box.scanner.run_once()
+        assert (domain_id, "wf-bad", run) in report.invalid_pending
+        assert not report.ok
+
+    def test_watchdog_rolls_up_health(self):
+        from cadence_tpu.engine.onebox import Onebox
+        from cadence_tpu.engine.workers import Watchdog
+        from cadence_tpu.models.deciders import EchoDecider
+        from tests.taskpoller import TaskPoller
+
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain("wd-dom")
+        box.frontend.start_workflow_execution("wd-dom", "wf-w", "echo", "wd-tl")
+        TaskPoller(box, "wd-dom", "wd-tl", {"wf-w": EchoDecider("wd-tl")}).drain()
+        report = Watchdog(box).run_once()
+        assert report["ok"]
+        assert report["executions"] >= 1
+        assert report["verified_on_device"] >= 1
