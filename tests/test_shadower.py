@@ -1,0 +1,88 @@
+"""Workflow shadowing (inventory row 37; service/worker/shadower):
+recorded histories replayed against current decider code, nondeterminism
+flagged decision-by-decision.
+"""
+import pytest
+
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.engine.shadower import WorkflowShadower, shadow_history
+from cadence_tpu.models.deciders import (
+    ChainedActivityDecider,
+    EchoDecider,
+    TimerDecider,
+)
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "sh-domain"
+TL = "sh-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def run_workflow(box, wf, decider, wtype="echo"):
+    box.frontend.start_workflow_execution(DOMAIN, wf, wtype, TL)
+    TaskPoller(box, DOMAIN, TL, {wf: decider}).drain()
+    domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+    return domain_id, box.stores.execution.get_current_run_id(domain_id, wf)
+
+
+class TestShadower:
+    def test_same_decider_shadows_clean(self, box):
+        domain_id, run = run_workflow(box, "wf-s", EchoDecider(TL))
+        result = WorkflowShadower(box.stores).shadow_workflow(
+            domain_id, "wf-s", run, EchoDecider(TL))
+        assert result.ok and result.decisions_checked >= 2
+
+    def test_changed_decider_flags_nondeterminism(self, box):
+        """Deploying TimerDecider over histories recorded by EchoDecider is
+        exactly the break shadowing exists to catch."""
+        domain_id, run = run_workflow(box, "wf-nd", EchoDecider(TL))
+        result = WorkflowShadower(box.stores).shadow_workflow(
+            domain_id, "wf-nd", run, TimerDecider(fire_seconds=5))
+        assert not result.ok
+        mismatch = result.mismatches[0]
+        assert mismatch.decision_index == 0
+        assert mismatch.expected != mismatch.recorded
+
+    def test_multi_decision_chain_shadows_clean(self, box):
+        decider = ChainedActivityDecider(TL, chain_length=3)
+        domain_id, run = run_workflow(box, "wf-chain", decider, "basic")
+        result = WorkflowShadower(box.stores).shadow_workflow(
+            domain_id, "wf-chain", run,
+            ChainedActivityDecider(TL, chain_length=3))
+        assert result.ok and result.decisions_checked >= 4
+
+    def test_shadow_query_sweeps_by_type(self, box):
+        run_workflow(box, "wf-a", EchoDecider(TL), "echo")
+        run_workflow(box, "wf-b", ChainedActivityDecider(TL, 2), "basic")
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        results = WorkflowShadower(box.stores).shadow_query(
+            domain_id, "CloseStatus = 'Completed'",
+            {"echo": EchoDecider(TL),
+             "basic": ChainedActivityDecider(TL, 2)})
+        assert len(results) == 2 and all(r.ok for r in results)
+
+    def test_cron_continue_as_new_shadows_clean(self, box):
+        """The engine translates a cron run's CompleteWorkflowExecution
+        into ContinuedAsNew; shadowing must accept that translation
+        (code-review r4)."""
+        from cadence_tpu.models.deciders import CompleteDecider
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-cron", "cron", TL,
+                                              cron_schedule="@every 60s")
+        TaskPoller(box, DOMAIN, TL, {"wf-cron": CompleteDecider()}).drain()
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        # shadow the FIRST (continued-as-new) run
+        runs = [r for (d, w, r) in box.stores.history.list_runs()
+                if d == domain_id and w == "wf-cron"]
+        shadower = WorkflowShadower(box.stores)
+        results = [shadower.shadow_workflow(domain_id, "wf-cron", run,
+                                            CompleteDecider())
+                   for run in runs]
+        closed = [r for r in results if r.decisions_checked >= 1]
+        assert closed and all(r.ok for r in closed)
