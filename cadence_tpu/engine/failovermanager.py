@@ -1,0 +1,131 @@
+"""Managed failover: the failover workflow as an explicit coordinator.
+
+Reference: service/worker/failovermanager/workflow.go — an operator
+kicks off a failover workflow that processes domains in batches: drain
+replication, flip the active cluster, verify, report per-domain status;
+`rebalance` moves every mis-homed domain. The reference runs this as a
+system workflow on the Cadence SDK; here it is a coordinator with the
+same step structure and per-domain failure isolation, driven by the
+operator (or a cron'd host loop).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..utils.log import DEFAULT_LOGGER
+
+STATUS_SUCCESS = "success"
+STATUS_FAILED = "failed"
+STATUS_SKIPPED = "skipped"
+
+
+@dataclass
+class DomainFailoverResult:
+    domain: str
+    status: str
+    detail: str = ""
+    new_failover_version: Optional[int] = None
+
+
+@dataclass
+class FailoverReport:
+    to_cluster: str
+    results: List[DomainFailoverResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.status != STATUS_FAILED for r in self.results)
+
+    @property
+    def succeeded(self) -> int:
+        return sum(1 for r in self.results if r.status == STATUS_SUCCESS)
+
+
+class FailoverManager:
+    def __init__(self, clusters) -> None:
+        self.clusters = clusters
+        self.log = DEFAULT_LOGGER.with_tags(component="failovermanager")
+
+    def _box(self, cluster: str):
+        return (self.clusters.active if cluster == "primary"
+                else self.clusters.standby)
+
+    def managed_failover(self, domains: List[str],
+                         to_cluster: str = "standby",
+                         batch_size: int = 2) -> FailoverReport:
+        """Failover workflow body (failovermanager/workflow.go): domains
+        process in batches; per domain — drain replication so the target
+        is caught up, flip the active cluster through the ACTIVE side's
+        UpdateDomain (stamping the next failover version), stream the
+        flip to the peer, regenerate the new active side's tasks, and
+        verify both sides agree. One bad domain never aborts the rest."""
+        report = FailoverReport(to_cluster=to_cluster)
+        for lo in range(0, len(domains), batch_size):
+            # ONE full replication drain per BATCH — the cost batching
+            # amortizes (the reference pages domains for the same reason)
+            try:
+                self.clusters.replicate()
+                self.clusters.replicate_reverse()
+            except Exception as exc:
+                for name in domains[lo:lo + batch_size]:
+                    report.results.append(DomainFailoverResult(
+                        name, STATUS_FAILED, f"drain failed: {exc}"))
+                continue
+            for name in domains[lo:lo + batch_size]:
+                report.results.append(self._failover_one(name, to_cluster))
+        self.log.info("managed failover finished", to=to_cluster,
+                      succeeded=report.succeeded,
+                      failed=sum(1 for r in report.results
+                                 if r.status == STATUS_FAILED))
+        return report
+
+    def _failover_one(self, name: str,
+                      to_cluster: str) -> DomainFailoverResult:
+        from .multicluster import _refresh_domain_tasks
+        try:
+            current = self.clusters.active.stores.domain.by_name(name)
+        except Exception as exc:
+            return DomainFailoverResult(name, STATUS_FAILED, str(exc))
+        if len(current.clusters) < 2:
+            return DomainFailoverResult(name, STATUS_SKIPPED,
+                                        "local (single-cluster) domain")
+        if current.active_cluster == to_cluster:
+            return DomainFailoverResult(name, STATUS_SKIPPED,
+                                        f"already active in {to_cluster}")
+        try:
+            # (the batch loop already drained replication for this batch)
+            # flip through the active side's UpdateDomain (validated,
+            #    notification-ordered, failover-version advanced)
+            source = self._box(current.active_cluster)
+            updated = source.frontend.update_domain(
+                name, active_cluster=to_cluster)
+            # 3. stream the flip to the peer
+            self.clusters.replicate_domains()
+            # 4. the new active side regenerates outstanding tasks
+            #    (standby promotion sweep, task_refresher)
+            _refresh_domain_tasks(self._box(to_cluster), name)
+            # 5. verify convergence
+            for box in (self.clusters.active, self.clusters.standby):
+                d = box.stores.domain.by_name(name)
+                if d.active_cluster != to_cluster:
+                    raise RuntimeError(
+                        f"{box.cluster_name} still says active="
+                        f"{d.active_cluster}")
+            self.log.info("domain failed over", domain=name, to=to_cluster,
+                          failover_version=updated.failover_version)
+            return DomainFailoverResult(name, STATUS_SUCCESS,
+                                        new_failover_version=(
+                                            updated.failover_version))
+        except Exception as exc:  # per-domain isolation, batcher posture
+            self.log.error("domain failover failed", domain=name,
+                           error=str(exc))
+            return DomainFailoverResult(name, STATUS_FAILED, str(exc))
+
+    def rebalance(self, home_cluster: str = "primary") -> FailoverReport:
+        """Rebalance workflow (failovermanager/rebalance.go): move every
+        GLOBAL domain whose active cluster is not its home back home."""
+        mis_homed = [d.name
+                     for d in self.clusters.active.stores.domain.list_domains()
+                     if len(d.clusters) > 1 and d.active_cluster != home_cluster]
+        return self.managed_failover(mis_homed, to_cluster=home_cluster)

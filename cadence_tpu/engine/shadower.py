@@ -39,10 +39,11 @@ _DECISION_EVENT = {
     DecisionType.StartChildWorkflowExecution:
         (EventType.StartChildWorkflowExecutionInitiated, "workflow_id"),
     DecisionType.RequestCancelExternalWorkflowExecution:
-        (EventType.RequestCancelExternalWorkflowExecutionInitiated, None),
+        (EventType.RequestCancelExternalWorkflowExecutionInitiated,
+         "workflow_id"),
     DecisionType.SignalExternalWorkflowExecution:
-        (EventType.SignalExternalWorkflowExecutionInitiated, None),
-    DecisionType.RecordMarker: (EventType.MarkerRecorded, None),
+        (EventType.SignalExternalWorkflowExecutionInitiated, "signal_name"),
+    DecisionType.RecordMarker: (EventType.MarkerRecorded, "marker_name"),
     DecisionType.UpsertWorkflowSearchAttributes:
         (EventType.UpsertWorkflowSearchAttributes, None),
     DecisionType.RequestCancelActivityTask:
@@ -53,8 +54,13 @@ _DECISION_EVENT = {
 #: comparison universe; engine-originated events like timeouts are not
 #: decider output and are skipped)
 _DECISION_ORIGINATED = {ev for ev, _ in _DECISION_EVENT.values()}
+# the engine records RequestCancelActivityTaskFailed (unknown/finished
+# activity id) INSTEAD of ActivityTaskCancelRequested for the same
+# decision — part of the comparison universe and an accepted outcome
+_DECISION_ORIGINATED.add(EventType.RequestCancelActivityTaskFailed)
 #: event type → identity attribute (inverse of _DECISION_EVENT's values)
 _EVENT_ID_ATTR = {ev: attr for ev, attr in _DECISION_EVENT.values()}
+_EVENT_ID_ATTR[EventType.RequestCancelActivityTaskFailed] = "activity_id"
 
 #: close decisions the ENGINE may legitimately translate into a
 #: continue-as-new (cron schedules continue a completed run, retry
@@ -68,10 +74,16 @@ _CLOSE_TRANSLATABLE = {EventType.WorkflowExecutionCompleted,
 def _entry_matches(expected: Tuple, recorded: Tuple) -> bool:
     if expected == recorded:
         return True
-    exp_type, _ = expected
-    rec_type, _ = recorded
-    return (rec_type == EventType.WorkflowExecutionContinuedAsNew
-            and exp_type in _CLOSE_TRANSLATABLE)
+    exp_type, exp_id = expected
+    rec_type, rec_id = recorded
+    if (rec_type == EventType.WorkflowExecutionContinuedAsNew
+            and exp_type in _CLOSE_TRANSLATABLE):
+        return True
+    # a cancel decision for an unknown/finished activity legitimately
+    # records the Failed variant (history_engine RequestCancelActivityTask)
+    return (exp_type == EventType.ActivityTaskCancelRequested
+            and rec_type == EventType.RequestCancelActivityTaskFailed
+            and exp_id == rec_id)
 
 
 def _signatures_match(expected: List[Tuple], recorded: List[Tuple]) -> bool:
@@ -94,10 +106,13 @@ class ShadowResult:
     run_id: str
     decisions_checked: int = 0
     mismatches: List[ShadowMismatch] = field(default_factory=list)
+    #: the decider RAISED mid-replay (itself a replay break worth
+    #: surfacing; the sweep isolates it per run, never aborts)
+    error: str = ""
 
     @property
     def ok(self) -> bool:
-        return not self.mismatches
+        return not self.mismatches and not self.error
 
 
 def _signature(decisions) -> List[Tuple]:
@@ -179,6 +194,13 @@ class WorkflowShadower:
             decider = deciders_by_type.get(rec.workflow_type)
             if decider is None:
                 continue
-            results.append(self.shadow_workflow(domain_id, rec.workflow_id,
-                                                rec.run_id, decider))
+            try:
+                results.append(self.shadow_workflow(
+                    domain_id, rec.workflow_id, rec.run_id, decider))
+            except Exception as exc:
+                # a decider crashing on an old history IS a replay break;
+                # isolate it per run (batcher/failovermanager posture)
+                results.append(ShadowResult(
+                    workflow_id=rec.workflow_id, run_id=rec.run_id,
+                    error=f"{type(exc).__name__}: {exc}"))
         return results

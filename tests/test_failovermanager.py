@@ -1,0 +1,90 @@
+"""Managed failover workflow (inventory row 36;
+service/worker/failovermanager/workflow.go): batched domain failover
+with drain → flip → replicate → refresh → verify, plus rebalance.
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus
+from cadence_tpu.engine.failovermanager import (
+    STATUS_FAILED,
+    STATUS_SKIPPED,
+    STATUS_SUCCESS,
+    FailoverManager,
+)
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from cadence_tpu.models.deciders import SignalDecider
+from tests.taskpoller import TaskPoller
+
+TL = "fm-tl"
+
+
+@pytest.fixture()
+def clusters():
+    return ReplicatedClusters(num_hosts=1, num_shards=4)
+
+
+class TestManagedFailover:
+    def test_batched_failover_with_inflight_workflows(self, clusters):
+        for name in ("fm-a", "fm-b", "fm-c"):
+            clusters.register_global_domain(name)
+        # an in-flight workflow on fm-a: one signal received, one to go
+        clusters.active.frontend.start_workflow_execution(
+            "fm-a", "wf-live", "sig", TL)
+        apoller = TaskPoller(clusters.active, "fm-a", TL,
+                             {"wf-live": SignalDecider(expected_signals=2)})
+        clusters.active.frontend.signal_workflow_execution("fm-a", "wf-live",
+                                                           "s1")
+        apoller.drain()
+
+        report = FailoverManager(clusters).managed_failover(
+            ["fm-a", "fm-b", "fm-c"], to_cluster="standby", batch_size=2)
+        assert report.ok and report.succeeded == 3
+        for box in (clusters.active, clusters.standby):
+            for name in ("fm-a", "fm-b", "fm-c"):
+                assert box.stores.domain.by_name(
+                    name).active_cluster == "standby"
+
+        # the in-flight workflow CONTINUES on the new active side
+        domain_id = clusters.standby.frontend.describe_domain("fm-a").domain_id
+        spoller = TaskPoller(clusters.standby, "fm-a", TL,
+                             {"wf-live": SignalDecider(expected_signals=2)})
+        clusters.standby.frontend.signal_workflow_execution("fm-a", "wf-live",
+                                                            "s2")
+        spoller.drain()
+        run = clusters.standby.stores.execution.get_current_run_id(
+            domain_id, "wf-live")
+        ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "wf-live", run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert clusters.standby.tpu.verify_all().ok
+
+    def test_skips_local_and_already_active(self, clusters):
+        clusters.register_global_domain("fm-g")
+        clusters.active.frontend.register_domain("fm-local")
+        fm = FailoverManager(clusters)
+        first = fm.managed_failover(["fm-g", "fm-local"], "standby")
+        statuses = {r.domain: r.status for r in first.results}
+        assert statuses == {"fm-g": STATUS_SUCCESS,
+                            "fm-local": STATUS_SKIPPED}
+        again = fm.managed_failover(["fm-g"], "standby")
+        assert again.results[0].status == STATUS_SKIPPED
+
+    def test_rebalance_brings_domains_home(self, clusters):
+        for name in ("fm-x", "fm-y"):
+            clusters.register_global_domain(name)
+        fm = FailoverManager(clusters)
+        fm.managed_failover(["fm-x", "fm-y"], "standby")
+        report = fm.rebalance(home_cluster="primary")
+        assert report.ok and report.succeeded == 2
+        for name in ("fm-x", "fm-y"):
+            assert clusters.active.stores.domain.by_name(
+                name).active_cluster == "primary"
+
+    def test_unknown_domain_isolated(self, clusters):
+        clusters.register_global_domain("fm-ok")
+        report = FailoverManager(clusters).managed_failover(
+            ["no-such-domain", "fm-ok"], "standby")
+        statuses = {r.domain: r.status for r in report.results}
+        assert statuses["no-such-domain"] == STATUS_FAILED
+        assert statuses["fm-ok"] == STATUS_SUCCESS
+        assert not report.ok

@@ -134,3 +134,38 @@ class TestWireCluster:
         drive_workflow(fe0, target_wf)
         ms = fe0.describe_workflow_execution(DOMAIN, target_wf)
         assert ms.execution_info.close_status == CloseStatus.Completed
+
+
+class TestWireApiSurface:
+    def test_new_apis_work_over_the_wire(self, cluster):
+        """SignalWithStart, query visibility, count, domain update, and
+        batch all cross the process boundary (pickled args/results over
+        real sockets)."""
+        fe = cluster.frontend(0)
+        run = fe.signal_with_start_workflow_execution(
+            DOMAIN, "wf-sws-wire", signal_name="go",
+            workflow_type="orders", task_list=TL)
+        assert run
+        fe.update_domain(DOMAIN, description="wire-updated")
+        assert fe.describe_domain(DOMAIN).description == "wire-updated"
+        assert fe.count_workflow_executions(DOMAIN) >= 0
+        # drive the decision so visibility records the start (host-1 was
+        # SIGKILLed by the steal test earlier in this module: the survivor
+        # serving everything IS the point)
+        drive_workflow(fe, "wf-sws-wire")
+        # visibility trails the async close-task pump: poll briefly
+        deadline = time.monotonic() + 10
+        hits = []
+        while time.monotonic() < deadline:
+            hits = fe.list_workflow_executions(
+                DOMAIN,
+                "WorkflowType = 'orders' AND CloseStatus = 'Completed'")
+            if hits:
+                break
+            time.sleep(0.1)
+        assert "wf-sws-wire" in [r.workflow_id for r in hits]
+        # batch signal over the wire (no open matches left: zero targets)
+        from cadence_tpu.engine.batcher import Batcher
+        report = Batcher(fe, rps=100).run(
+            DOMAIN, "WorkflowType = 'orders'", "signal", signal_name="x")
+        assert report.total == 0
