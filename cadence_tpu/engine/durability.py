@@ -235,6 +235,9 @@ def current_run_record(domain_id: str, workflow_id: str,
 
 
 def queue_record(queue: str, payload) -> dict:
+    from dataclasses import asdict
+
+    from .crosscluster import CrossClusterTask
     from .domainrepl import DomainReplicationTask
     from .replication import DLQEntry, ReplicationTask
     if isinstance(payload, ReplicationTask):
@@ -244,9 +247,11 @@ def queue_record(queue: str, payload) -> dict:
         body = {"task": _repl_task_dict(payload.task), "err": payload.error}
         kind = "dlq"
     elif isinstance(payload, DomainReplicationTask):
-        from dataclasses import asdict
         body = dict(asdict(payload), clusters=list(payload.clusters))
         kind = "domain"
+    elif isinstance(payload, CrossClusterTask):
+        body = asdict(payload)
+        kind = "xc"
     else:
         raise TypeError(
             f"queue payload {type(payload).__name__} is not durable — "
@@ -389,6 +394,9 @@ def recover_stores(path: str, verify_on_device: bool = True,
                 body = dict(rec["p"])
                 body["clusters"] = tuple(body["clusters"])
                 stores.queue.enqueue(rec["q"], DomainReplicationTask(**body))
+            elif rec["k"] == "xc":
+                from .crosscluster import CrossClusterTask
+                stores.queue.enqueue(rec["q"], CrossClusterTask(**rec["p"]))
             else:
                 from .replication import DLQEntry
                 stores.queue.enqueue(rec["q"], DLQEntry(

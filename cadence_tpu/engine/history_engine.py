@@ -1311,6 +1311,34 @@ class HistoryEngine:
                 initiated_event_id=initiated_id, run_id=child_run_id)
         txn.commit(expected)
 
+    def on_child_start_failed(self, domain_id: str, workflow_id: str,
+                              run_id: str, initiated_id: int,
+                              cause: str = "WORKFLOW_ALREADY_RUNNING") -> None:
+        """StartChildWorkflowExecutionFailed on the parent (the start
+        could not be honored — target already running; the cross-cluster
+        and local start paths share this response arm)."""
+        ms, expected = self._load(domain_id, workflow_id, run_id)
+        ci = ms.pending_child_execution_info_ids.get(initiated_id)
+        if ci is None or ci.started_id != EMPTY_EVENT_ID:
+            return
+        if self._has_inflight_decision(ms):
+            # at-least-once delivery: a redelivered failure must not
+            # buffer a second Failed event (the double delete would break
+            # replay) — mirror on_child_closed's buffered dedup
+            if any(e.event_type == EventType.StartChildWorkflowExecutionFailed
+                   and e.get("initiated_event_id") == initiated_id
+                   for e in ms.buffered_events):
+                return
+            self._buffer_event(ms, expected,
+                               EventType.StartChildWorkflowExecutionFailed,
+                               initiated_event_id=initiated_id, cause=cause)
+            return
+        txn = self._new_transaction(ms)
+        txn.add(EventType.StartChildWorkflowExecutionFailed,
+                initiated_event_id=initiated_id, cause=cause)
+        self._maybe_schedule_decision(txn, ms)
+        txn.commit(expected)
+
     def on_child_closed(self, domain_id: str, workflow_id: str, run_id: str,
                         initiated_id: int, close_event_type: EventType) -> None:
         ms, expected = self._load(domain_id, workflow_id, run_id)

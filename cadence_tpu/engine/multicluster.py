@@ -76,6 +76,33 @@ class ReplicatedClusters:
             self.reverse_domain_publisher)
         self.reverse_domain_processor = DomainReplicationProcessor(
             self.standby.stores, self.active.stores, "primary")
+        # cross-cluster task executors (cross_cluster_task_processor.go):
+        # operations whose TARGET domain is active on the peer park on a
+        # per-target queue; the peer's processor executes them and the
+        # result applies back on the source workflow
+        from .crosscluster import CrossClusterProcessor, CrossClusterPublisher
+        self.cross_cluster_publisher = CrossClusterPublisher(self.active.stores)
+        for p in self.active.processors:
+            p.cross_cluster_publisher = self.cross_cluster_publisher
+        self.reverse_cross_cluster_publisher = CrossClusterPublisher(
+            self.standby.stores)
+        for p in self.standby.processors:
+            p.cross_cluster_publisher = self.reverse_cross_cluster_publisher
+        # one consumer per (source store × executing cluster): the two
+        # cross pairs carry normal traffic; the two SELF pairs drain tasks
+        # re-homed after a failover flipped the target domain back
+        def _xc(source_box, exec_box, exec_name):
+            return CrossClusterProcessor(
+                source_box.stores, exec_box.route, source_box.route,
+                exec_name, target_stores=exec_box.stores)
+        self.cross_cluster_processor = _xc(self.active, self.standby,
+                                           "standby")
+        self.reverse_cross_cluster_processor = _xc(self.standby, self.active,
+                                                   "primary")
+        self._self_cross_cluster_processors = [
+            _xc(self.active, self.active, "primary"),
+            _xc(self.standby, self.standby, "standby"),
+        ]
 
     def _read_source_history(self, domain_id: str, workflow_id: str,
                              run_id: str, from_event_id: int,
@@ -168,6 +195,15 @@ class ReplicatedClusters:
             box.stores.domain.update(d)
         self.replicate()
         self.replicate_reverse()
+
+    def process_cross_cluster(self) -> int:
+        """Drain both clusters' parked cross-cluster tasks (including
+        tasks re-homed after an intervening failover)."""
+        total = (self.cross_cluster_processor.process_once()
+                 + self.reverse_cross_cluster_processor.process_once())
+        for proc in self._self_cross_cluster_processors:
+            total += proc.process_once()
+        return total
 
     def redirecting_frontend(self, cluster: str,
                              policy: str = "selected-apis-forwarding"):

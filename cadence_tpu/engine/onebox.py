@@ -59,7 +59,7 @@ class Onebox:
         self.processors = [
             QueueProcessors(c, self.matching, self.stores, self.clock,
                             router=self.route, metrics=self.metrics,
-                            config=self.config)
+                            config=self.config, cluster_name=cluster_name)
             for c in self.controllers.values()
         ]
         self.frontend = Frontend(self.stores, self.matching, self.route,
@@ -124,11 +124,15 @@ class Onebox:
                                      engine_factory=self._make_engine)
         self.controllers[name] = controller
         self.hosts.append(name)
-        self.processors.append(QueueProcessors(controller, self.matching,
-                                               self.stores, self.clock,
-                                               router=self.route,
-                                               metrics=self.metrics,
-                                               config=self.config))
+        proc = QueueProcessors(controller, self.matching, self.stores,
+                               self.clock, router=self.route,
+                               metrics=self.metrics, config=self.config,
+                               cluster_name=self.cluster_name)
+        if self.processors:
+            # inherit multi-cluster wiring done after construction
+            proc.cross_cluster_publisher = \
+                self.processors[0].cross_cluster_publisher
+        self.processors.append(proc)
         self.ring.add_member(name)
 
     def remove_host(self, name: str) -> None:

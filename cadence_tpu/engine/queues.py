@@ -48,7 +48,8 @@ class QueueProcessors:
 
     def __init__(self, controller: "ShardController", matching: MatchingEngine,
                  stores: Stores, time_source: TimeSource,
-                 router=None, metrics=None, config=None) -> None:
+                 router=None, metrics=None, config=None,
+                 cluster_name: str = "primary") -> None:
         from ..utils.dynamicconfig import DynamicConfig
         from ..utils.metrics import DEFAULT_REGISTRY
         self.metrics = metrics if metrics is not None else DEFAULT_REGISTRY
@@ -57,6 +58,11 @@ class QueueProcessors:
         self.matching = matching
         self.stores = stores
         self.clock = time_source
+        self.cluster_name = cluster_name
+        #: set by multi-cluster wiring (engine/crosscluster.py): tasks
+        #: targeting a domain active ELSEWHERE park for that cluster's
+        #: processor instead of executing locally at the wrong version
+        self.cross_cluster_publisher = None
         #: cluster-wide workflow→engine router for cross-workflow calls
         #: (the client/history peer-resolver analog); defaults to the local
         #: controller, which suffices for single-host clusters
@@ -211,13 +217,23 @@ class QueueProcessors:
                 and info.close_status != CloseStatus.ContinuedAsNew):
             close_event = _CHILD_CLOSE_EVENT.get(CloseStatus(info.close_status))
             if close_event is not None:
-                try:
-                    parent_engine = self.router(info.parent_workflow_id)
-                    parent_engine.on_child_closed(
-                        info.parent_domain_id, info.parent_workflow_id,
-                        info.parent_run_id, info.initiated_id, close_event)
-                except EntityNotExistsError:
-                    self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
+                from .crosscluster import KIND_CHILD_CLOSED
+                parked = (info.parent_domain_id != domain_id
+                          and self._park_cross_cluster(
+                              KIND_CHILD_CLOSED, domain_id, workflow_id,
+                              run_id, 0, info.parent_domain_id,
+                              info.parent_workflow_id,
+                              target_run_id=info.parent_run_id,
+                              parent_initiated_id=info.initiated_id,
+                              close_event_type=int(close_event)))
+                if not parked:
+                    try:
+                        parent_engine = self.router(info.parent_workflow_id)
+                        parent_engine.on_child_closed(
+                            info.parent_domain_id, info.parent_workflow_id,
+                            info.parent_run_id, info.initiated_id, close_event)
+                    except EntityNotExistsError:
+                        self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
         self._apply_parent_close_policy(ms)
 
     def _apply_parent_close_policy(self, parent_ms) -> None:
@@ -246,6 +262,21 @@ class QueueProcessors:
                         run_id = None
                 except EntityNotExistsError:
                     run_id = None
+            parent_domain = parent_ms.execution_info.domain_id
+            if child_domain != parent_domain:
+                from .crosscluster import (
+                    KIND_POLICY_CANCEL,
+                    KIND_POLICY_TERMINATE,
+                )
+                kind = (KIND_POLICY_TERMINATE
+                        if policy == ParentClosePolicy.Terminate
+                        else KIND_POLICY_CANCEL)
+                if self._park_cross_cluster(
+                        kind, parent_domain,
+                        parent_ms.execution_info.workflow_id,
+                        parent_ms.execution_info.run_id, 0, child_domain,
+                        ci.started_workflow_id, target_run_id=run_id or ""):
+                    continue
             try:
                 child_engine = self.router(ci.started_workflow_id)
                 if policy == ParentClosePolicy.Terminate:
@@ -259,10 +290,33 @@ class QueueProcessors:
                 # child already closed / cancel already requested
                 self._dropped_not_exists(SCOPE_QUEUE_TRANSFER)
 
+    def _park_cross_cluster(self, kind: str, domain_id: str,
+                            workflow_id: str, run_id: str, event_id: int,
+                            target_domain_id: str, target_workflow_id: str,
+                            **extra) -> bool:
+        """Park a task whose target domain is active on another cluster
+        (cross_cluster_task_processor.go seam); True when parked. The
+        source/target plumbing lives HERE so every executor parks with
+        one call (and one place grows when the task schema does)."""
+        if self.cross_cluster_publisher is None:
+            return False
+        from .crosscluster import CrossClusterTask, active_elsewhere
+        target_cluster = active_elsewhere(self.stores, target_domain_id,
+                                          self.cluster_name)
+        if target_cluster is None:
+            return False
+        self.cross_cluster_publisher.publish(target_cluster, CrossClusterTask(
+            kind=kind, source_domain_id=domain_id,
+            source_workflow_id=workflow_id, source_run_id=run_id,
+            event_id=event_id, target_domain_id=target_domain_id,
+            target_workflow_id=target_workflow_id, **extra))
+        return True
+
     def _start_child(self, engine: "HistoryEngine", domain_id: str,
                      workflow_id: str, run_id: str, task: GeneratedTask) -> None:
         """processStartChildExecution: start the child with parent linkage,
-        then deliver ChildWorkflowExecutionStarted to the parent."""
+        then deliver ChildWorkflowExecutionStarted to the parent. A child
+        domain active on ANOTHER cluster parks on the cross-cluster queue."""
         try:
             ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         except EntityNotExistsError:
@@ -275,6 +329,19 @@ class QueueProcessors:
         if ci.started_id != EMPTY_EVENT_ID:
             return  # redelivered task; child already started (idempotency)
         parent_info = ms.execution_info
+        child_domain = ci.domain_id or domain_id
+        if child_domain != domain_id:
+            from .crosscluster import KIND_START_CHILD
+            if self._park_cross_cluster(
+                    KIND_START_CHILD, domain_id, workflow_id, run_id,
+                    task.event_id, child_domain, ci.started_workflow_id,
+                    workflow_type=ci.workflow_type_name,
+                    task_list=parent_info.task_list,
+                    execution_timeout=parent_info.workflow_timeout,
+                    decision_timeout=parent_info.decision_start_to_close_timeout,
+                    parent_initiated_id=ci.initiated_id,
+                    create_request_id=ci.create_request_id):
+                return
         child_engine = self.router(ci.started_workflow_id)
         child_run_id = child_engine.start_workflow(
             domain_id=ci.domain_id or domain_id,
@@ -307,6 +374,15 @@ class QueueProcessors:
         si = ms.pending_signal_info_ids.get(task.event_id)
         if si is None:
             return
+        target_domain = task.target_domain_id or domain_id
+        if target_domain != domain_id:
+            from .crosscluster import KIND_SIGNAL
+            if self._park_cross_cluster(
+                    KIND_SIGNAL, domain_id, workflow_id, run_id,
+                    task.event_id, target_domain, task.target_workflow_id,
+                    target_run_id=task.target_run_id or "",
+                    signal_name=si.signal_name):
+                return
         failed = False
         try:
             target = self.router(task.target_workflow_id)
@@ -329,6 +405,14 @@ class QueueProcessors:
             return
         if task.event_id not in ms.pending_request_cancel_info_ids:
             return
+        target_domain = task.target_domain_id or domain_id
+        if target_domain != domain_id:
+            from .crosscluster import KIND_CANCEL
+            if self._park_cross_cluster(
+                    KIND_CANCEL, domain_id, workflow_id, run_id,
+                    task.event_id, target_domain, task.target_workflow_id,
+                    target_run_id=task.target_run_id or ""):
+                return
         failed = False
         try:
             target = self.router(task.target_workflow_id)

@@ -1,0 +1,174 @@
+"""Cross-cluster task executors (inventory row 15;
+service/history/task/cross_cluster_*.go): operations targeting a domain
+active on ANOTHER cluster park on a per-target queue, execute there, and
+the result applies back on the source workflow.
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType, EventType
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from tests.taskpoller import TaskPoller
+
+TL = "xc-tl"
+
+
+@pytest.fixture()
+def clusters():
+    c = ReplicatedClusters(num_hosts=1, num_shards=4)
+    # parent domain active on PRIMARY, child/target domain on STANDBY
+    c.register_global_domain("xc-parent")
+    c.register_global_domain("xc-child")
+    c.failover("xc-child", to_cluster="standby")
+    return c
+
+
+def _ids(c):
+    return (c.active.frontend.describe_domain("xc-parent").domain_id,
+            c.active.frontend.describe_domain("xc-child").domain_id)
+
+
+class _CrossChildDecider:
+    """Starts a child IN ANOTHER DOMAIN, completes when it closes."""
+
+    def __init__(self, child_domain_id, child_wf):
+        self.child_domain_id = child_domain_id
+        self.child_wf = child_wf
+
+    def decide(self, history):
+        closes = [e for e in history if e.event_type in (
+            EventType.ChildWorkflowExecutionCompleted,
+            EventType.ChildWorkflowExecutionFailed,
+            EventType.ChildWorkflowExecutionTerminated)]
+        if closes:
+            return [Decision(DecisionType.CompleteWorkflowExecution,
+                             {"result": b""})]
+        if any(e.event_type == EventType.StartChildWorkflowExecutionInitiated
+               for e in history):
+            return []
+        return [Decision(DecisionType.StartChildWorkflowExecution,
+                         {"workflow_id": self.child_wf,
+                          "workflow_type": "xc-child-type",
+                          "domain_id": self.child_domain_id,
+                          "task_list": TL})]
+
+
+class TestCrossClusterChild:
+    def test_child_starts_on_other_cluster_and_closes_back(self, clusters):
+        from cadence_tpu.models.deciders import CompleteDecider
+
+        parent_id, child_id = _ids(clusters)
+        clusters.active.frontend.start_workflow_execution(
+            "xc-parent", "wf-par", "par-type", TL)
+        apoller = TaskPoller(clusters.active, "xc-parent", TL,
+                             {"wf-par": _CrossChildDecider(child_id,
+                                                           "wf-chi")})
+        spoller = TaskPoller(clusters.standby, "xc-child", TL,
+                             {"wf-chi": CompleteDecider()})
+        for _ in range(40):
+            apoller.drain()
+            moved = clusters.process_cross_cluster()
+            spoller.drain()
+            moved += clusters.process_cross_cluster()
+            apoller.drain()
+            parent_run = clusters.active.stores.execution.get_current_run_id(
+                parent_id, "wf-par")
+            ms = clusters.active.stores.execution.get_workflow(
+                parent_id, "wf-par", parent_run)
+            if ms.execution_info.close_status == CloseStatus.Completed:
+                break
+        # the child RAN on the standby, with parent linkage to primary
+        child_run = clusters.standby.stores.execution.get_current_run_id(
+            child_id, "wf-chi")
+        child_ms = clusters.standby.stores.execution.get_workflow(
+            child_id, "wf-chi", child_run)
+        assert child_ms.execution_info.close_status == CloseStatus.Completed
+        assert child_ms.execution_info.parent_workflow_id == "wf-par"
+        # the parent SAW the start and the close across the cluster boundary
+        events = clusters.active.stores.history.read_events(
+            parent_id, "wf-par", parent_run)
+        types = [e.event_type for e in events]
+        assert EventType.ChildWorkflowExecutionStarted in types
+        assert EventType.ChildWorkflowExecutionCompleted in types
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert clusters.active.tpu.verify_all().ok
+        assert clusters.standby.tpu.verify_all().ok
+
+    def test_cross_cluster_signal_external(self, clusters):
+        """A workflow on primary signals an execution living in a domain
+        active on the STANDBY; the delivery confirmation comes back."""
+        from cadence_tpu.models.deciders import SignalDecider
+
+        parent_id, child_id = _ids(clusters)
+        # the target lives on the standby
+        clusters.standby.frontend.start_workflow_execution(
+            "xc-child", "wf-target", "sig", TL)
+        # the source on primary: first decision signals the external target
+        clusters.active.frontend.start_workflow_execution(
+            "xc-parent", "wf-src", "src", TL)
+
+        class SignalExternalDecider:
+            def decide(self, history):
+                if any(e.event_type ==
+                       EventType.ExternalWorkflowExecutionSignaled
+                       for e in history):
+                    return [Decision(DecisionType.CompleteWorkflowExecution,
+                                     {"result": b""})]
+                if any(e.event_type ==
+                       EventType.SignalExternalWorkflowExecutionInitiated
+                       for e in history):
+                    return []
+                return [Decision(
+                    DecisionType.SignalExternalWorkflowExecution,
+                    {"workflow_id": "wf-target", "domain_id": child_id,
+                     "signal_name": "cross"})]
+
+        apoller = TaskPoller(clusters.active, "xc-parent", TL,
+                             {"wf-src": SignalExternalDecider()})
+        spoller = TaskPoller(clusters.standby, "xc-child", TL,
+                             {"wf-target": SignalDecider(expected_signals=1)})
+        for _ in range(40):
+            apoller.drain()
+            clusters.process_cross_cluster()
+            spoller.drain()
+            clusters.process_cross_cluster()
+            apoller.drain()
+            run = clusters.active.stores.execution.get_current_run_id(
+                parent_id, "wf-src")
+            ms = clusters.active.stores.execution.get_workflow(
+                parent_id, "wf-src", run)
+            if ms.execution_info.close_status == CloseStatus.Completed:
+                break
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        # the target on the standby got the signal and completed
+        trun = clusters.standby.stores.execution.get_current_run_id(
+            child_id, "wf-target")
+        tms = clusters.standby.stores.execution.get_workflow(
+            child_id, "wf-target", trun)
+        assert tms.execution_info.close_status == CloseStatus.Completed
+
+
+class TestFailoverRaces:
+    def test_parked_task_rehomes_after_failover(self, clusters):
+        """A task parked for the standby executes on PRIMARY when the
+        target domain fails back before processing (code-review r4: never
+        execute at a stale failover version)."""
+        parent_id, child_id = _ids(clusters)
+        clusters.active.frontend.start_workflow_execution(
+            "xc-parent", "wf-race", "par-type", TL)
+        apoller = TaskPoller(clusters.active, "xc-parent", TL,
+                             {"wf-race": _CrossChildDecider(child_id,
+                                                            "wf-chi-race")})
+        apoller.drain()  # parks the start-child for the standby
+        # the child domain fails BACK to primary before processing
+        clusters.failover("xc-child", to_cluster="primary")
+        moved = clusters.process_cross_cluster()
+        assert moved >= 1
+        # the child started on the PRIMARY (current active), not standby
+        run = clusters.active.stores.execution.get_current_run_id(
+            child_id, "wf-chi-race")
+        assert run
+        from cadence_tpu.engine.persistence import EntityNotExistsError
+        with pytest.raises(EntityNotExistsError):
+            clusters.standby.stores.execution.get_current_run_id(
+                child_id, "wf-chi-race")
