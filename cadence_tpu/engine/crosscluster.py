@@ -185,8 +185,26 @@ class CrossClusterProcessor:
                 request_id=task.create_request_id,
             )
         except WorkflowAlreadyStartedError:
-            # the reference records StartChildWorkflowExecutionFailed on
-            # the parent (cross_cluster_source_task_executor response arm)
+            # At-least-once redelivery: when the running execution was
+            # created by THIS task (same create request id — the
+            # reference's StartRequestID dedup arm in startWorkflowHelper),
+            # the earlier attempt's start committed but the result leg
+            # failed; report started with the existing run, not failed.
+            if task.create_request_id:
+                try:
+                    existing = target.get_mutable_state(
+                        task.target_domain_id, task.target_workflow_id)
+                    info = existing.execution_info
+                    if info.create_request_id == task.create_request_id:
+                        self._source_engine(task).on_child_started(
+                            task.source_domain_id, task.source_workflow_id,
+                            task.source_run_id, task.event_id, info.run_id)
+                        return
+                except EntityNotExistsError:
+                    pass
+            # a DIFFERENT execution holds the workflow id: the reference
+            # records StartChildWorkflowExecutionFailed on the parent
+            # (cross_cluster_source_task_executor response arm)
             self._source_engine(task).on_child_start_failed(
                 task.source_domain_id, task.source_workflow_id,
                 task.source_run_id, task.event_id)
@@ -198,10 +216,17 @@ class CrossClusterProcessor:
     def _signal(self, task: CrossClusterTask) -> None:
         failed = False
         try:
+            # the task's identity doubles as a signal request id so a
+            # redelivery after a transient result-leg failure does not
+            # append a duplicate WorkflowExecutionSignaled event (the
+            # reference's SignalRequestID dedup in AddSignalRequested)
+            dedup = (f"xc-signal:{task.source_run_id}:{task.event_id}"
+                     if task.source_run_id else None)
             self.target_router(task.target_workflow_id).signal_workflow(
                 task.target_domain_id, task.target_workflow_id,
                 signal_name=task.signal_name,
-                run_id=task.target_run_id or None)
+                run_id=task.target_run_id or None,
+                request_id=dedup)
         except EntityNotExistsError:
             failed = True
         self._source_engine(task).on_external_signaled(

@@ -141,17 +141,32 @@ def wal_version(records: List[dict]) -> int:
 
 def migrate_records(records: List[dict]) -> Tuple[List[dict], int]:
     """Lift records to WAL_VERSION in memory (update-schema's versioned
-    upgrade chain); returns (records, original_version)."""
+    upgrade chain); returns (records, original_version).
+
+    Migration is POSITIONAL: each record lifts from the version in effect
+    at its place in the file (the last header seen so far; pre-header
+    records are v1). A mixed log — an old prefix plus current-format
+    records appended after recovery stamps a mid-file header — migrates
+    only the prefix, so migrations need not be idempotent."""
     version = wal_version(records)
     if version > WAL_VERSION:
         raise SchemaVersionError(
             f"WAL schema v{version} is newer than this binary's "
             f"v{WAL_VERSION}; upgrade the binary, not the data")
     original = version
-    body = [r for r in records if r.get("t") != "ver"]
-    while version < WAL_VERSION:
-        body = [_MIGRATIONS[version](dict(r)) for r in body]
-        version += 1
+    body: List[dict] = []
+    effective = 1
+    for rec in records:
+        if rec.get("t") == "ver":
+            effective = rec["v"]
+            continue
+        v = effective
+        if v < WAL_VERSION:
+            rec = dict(rec)
+            while v < WAL_VERSION:
+                rec = _MIGRATIONS[v](rec)
+                v += 1
+        body.append(rec)
     return body, original
 
 
@@ -409,7 +424,15 @@ def recover_stores(path: str, verify_on_device: bool = True,
     # new writes continue the same log (records are idempotent to replay:
     # recovery takes the last pointer values and appends are per-branch
     # contiguous, so a recovered process re-logging is consistent)
-    stores.attach_wal(DurableLog(path))
+    wal = DurableLog(path)
+    if _original < WAL_VERSION:
+        # records appended from here on are CURRENT-format; stamp a
+        # mid-file version header ("last ver record wins") so the next
+        # recovery doesn't re-run migrations over already-lifted records —
+        # safe today only because _migrate_1_to_2 is idempotent, required
+        # the moment any migration isn't
+        wal.append(version_record())
+    stores.attach_wal(wal)
     return stores, report
 
 

@@ -207,7 +207,10 @@ class Frontend:
 
     def signal_workflow_execution(self, domain: str, workflow_id: str,
                                   signal_name: str,
-                                  run_id: Optional[str] = None) -> None:
+                                  run_id: Optional[str] = None,
+                                  request_id: Optional[str] = None) -> None:
+        """request_id (SignalWorkflowExecutionRequest.RequestId) dedups
+        client retries: a signal already applied under the same id no-ops."""
         from ..utils import metrics as m
         from .authorization import PERMISSION_WRITE
         self._authorize("SignalWorkflowExecution", PERMISSION_WRITE, domain)
@@ -216,7 +219,8 @@ class Frontend:
         info = self.stores.domain.by_name(domain)
         require_active(info, self.cluster_name)
         self.router(workflow_id).signal_workflow(info.domain_id, workflow_id,
-                                                 signal_name, run_id)
+                                                 signal_name, run_id,
+                                                 request_id=request_id)
 
     def signal_with_start_workflow_execution(
             self, domain: str, workflow_id: str, signal_name: str,

@@ -949,11 +949,20 @@ class HistoryEngine:
     # ------------------------------------------------------------------
 
     def signal_workflow(self, domain_id: str, workflow_id: str,
-                        signal_name: str, run_id: Optional[str] = None) -> None:
+                        signal_name: str, run_id: Optional[str] = None,
+                        request_id: Optional[str] = None) -> None:
+        """request_id dedups at-least-once signal legs (historyEngine.go
+        SignalWorkflowExecution's IsSignalRequested/AddSignalRequested): a
+        redelivered signal with an already-applied request id is a no-op
+        instead of a duplicate WorkflowExecutionSignaled event."""
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_SIGNAL, m.M_REQUESTS)
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
+        if request_id and request_id in ms.signal_requested_ids:
+            return
+        if request_id:
+            ms.signal_requested_ids.add(request_id)
         if self._has_inflight_decision(ms):
             # buffered until the in-flight decision closes; no new decision
             # scheduled (one is already running)

@@ -249,8 +249,17 @@ class _TaskListManager:
                     self._stores.task.complete_tasks_less_than(
                         self._info.domain_id, self._info.name,
                         self._info.task_type, self._ack)
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # best-effort GC: deferral is fine (the next ack
+                    # retries from the advanced level) but NEVER silent —
+                    # a programming error or corrupted store must surface
+                    from ..utils.log import DEFAULT_LOGGER
+                    from ..utils.metrics import DEFAULT_REGISTRY
+                    DEFAULT_REGISTRY.inc("matching", "task-gc-failures")
+                    DEFAULT_LOGGER.warning(
+                        "task GC deferred", component="matching",
+                        task_list=self._info.name, level=self._ack,
+                        error=repr(exc))
 
     def poll(self) -> Optional[PersistedTask]:
         with self._lock:

@@ -94,6 +94,13 @@ class TaskScheduler:
         self._work = threading.Condition(self._lock)
         self._queues: Dict[str, Deque] = {}
         self._rr: Deque[str] = deque()
+        #: redispatch parking lot: (ready_at, seq, key, fn, on_done,
+        #: attempt) min-heap. Backoff is a NOT-BEFORE timestamp, not a
+        #: worker-thread sleep — a retrying domain must not occupy 1/N of
+        #: pool capacity while it waits (redispatcher.go's timer-driven
+        #: redispatch, per advisor finding r4)
+        self._delayed: list = []
+        self._delay_seq = 0
         self._stopping = False
         self._active = 0
         self._idle = threading.Condition(self._lock)
@@ -120,6 +127,7 @@ class TaskScheduler:
         """Round-robin over keys with work (the fairness contract). Keys
         whose queues drained are pruned so the scan stays proportional to
         keys with PENDING work, not every key ever seen."""
+        self._promote_ready_locked()
         for _ in range(len(self._rr)):
             key = self._rr[0]
             q = self._queues.get(key)
@@ -151,17 +159,24 @@ class TaskScheduler:
                     # poison to the DLQ and advances past it)
                     self._kill(key, fn, "retries exhausted")
                 else:
-                    # exponential redispatch backoff (redispatcher.go)
+                    # exponential redispatch backoff (redispatcher.go):
+                    # park with a not-before timestamp — the worker moves
+                    # straight on to other domains' tasks
                     import time as _time
-                    _time.sleep(min(self.retry_delay * (2 ** attempt), 1.0))
-                    try:
-                        self.submit(key, fn, on_done, _attempt=attempt + 1)
-                        on_done = None  # completion fires on the final try
-                    except RuntimeError:
-                        # stopped mid-redispatch: do NOT ack — the task
-                        # must redeliver from the persisted level on
-                        # restart, and the worker must exit cleanly
-                        on_done = None
+                    ready_at = _time.monotonic() + min(
+                        self.retry_delay * (2 ** attempt), 1.0)
+                    with self._lock:
+                        if not self._stopping:
+                            import heapq
+                            self._delay_seq += 1
+                            heapq.heappush(self._delayed,
+                                           (ready_at, self._delay_seq, key,
+                                            fn, on_done, attempt + 1))
+                            self._work.notify()
+                    # stopped mid-redispatch: the parked task is dropped
+                    # un-acked — it redelivers from the persisted level on
+                    # restart. Either way completion fires on the final try
+                    on_done = None
             except Exception:
                 self._kill(key, fn, "non-retryable failure")
             finally:
@@ -185,12 +200,29 @@ class TaskScheduler:
         DEFAULT_LOGGER.error("task dead-lettered", component="scheduler",
                              key=key, reason=why)
 
+    def _promote_ready_locked(self) -> None:
+        """Move parked redispatches whose not-before has passed back onto
+        their per-key queues (held under self._lock)."""
+        if not self._delayed:
+            return
+        import heapq
+        import time as _time
+        now = _time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key, fn, on_done, attempt = heapq.heappop(self._delayed)
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = deque()
+                self._rr.append(key)
+            q.append((fn, on_done, attempt))
+
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until every queued task has finished (tests/pumps)."""
         import time
         deadline = time.monotonic() + timeout
         with self._lock:
-            while any(self._queues.values()) or self._active:
+            while (any(self._queues.values()) or self._active
+                   or self._delayed):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False

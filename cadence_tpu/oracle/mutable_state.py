@@ -435,6 +435,7 @@ class MutableState:
         "domain_entry",
         "history_size",
         "buffered_events",
+        "signal_requested_ids",
     )
 
     def __init__(self, domain_entry: Optional["DomainEntry"] = None) -> None:
@@ -458,6 +459,11 @@ class MutableState:
         #: bufferedEvents / updateBufferedEvents); entries carry
         #: BUFFERED_EVENT_ID until FlushBufferedEvents reassigns them
         self.buffered_events: List["HistoryEvent"] = []
+        #: applied external-signal request ids (mutable_state_builder.go
+        #: signalRequestedIDs / AddSignalRequested): the at-least-once
+        #: signal legs dedup against this so a redelivered signal does not
+        #: append a duplicate WorkflowExecutionSignaled event
+        self.signal_requested_ids: set = set()
 
     # -- version bookkeeping ------------------------------------------------
 

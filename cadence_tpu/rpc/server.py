@@ -36,7 +36,7 @@ from ..engine.membership import HashRing
 from ..engine.queues import QueueProcessors
 from ..utils.clock import RealTimeSource
 from .client import RemoteEngine, RemoteMatching, RemoteStores
-from .wire import recv_frame, send_frame
+from .wire import recv_frame, send_frame, verify_hello
 
 
 class RoutedMatching:
@@ -222,6 +222,10 @@ class _Handler(socketserver.BaseRequestHandler):
         hop to a DEAD PEER was refused) is an op ERROR to report to the
         caller — only failures on THIS socket end the connection."""
         server: ServiceHost = self.server  # type: ignore[assignment]
+        try:
+            verify_hello(self.request)  # before the first pickle load
+        except (OSError, ConnectionError):
+            return
         while True:
             try:
                 req = recv_frame(self.request)

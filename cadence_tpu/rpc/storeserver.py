@@ -28,7 +28,7 @@ import time
 from typing import Dict, Tuple
 
 from ..engine.persistence import Stores
-from .wire import recv_frame, send_frame
+from .wire import recv_frame, send_frame, verify_hello
 
 
 class StoreServer(socketserver.ThreadingTCPServer):
@@ -57,6 +57,10 @@ class _Handler(socketserver.BaseRequestHandler):
         """One connection, many frames; op errors report to the caller,
         only THIS socket's failures end the connection (see server.py)."""
         server: StoreServer = self.server  # type: ignore[assignment]
+        try:
+            verify_hello(self.request)  # before the first pickle load
+        except (OSError, ConnectionError):
+            return
         while True:
             try:
                 req = recv_frame(self.request)

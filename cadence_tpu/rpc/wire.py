@@ -14,11 +14,14 @@ serialization tier.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import io
+import os
 import pickle
 import socket
 import struct
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -26,6 +29,65 @@ MAX_FRAME = 256 * 1024 * 1024
 
 class WireError(ConnectionError):
     """Framing violation or truncated peer stream."""
+
+
+# -- connection authentication ---------------------------------------------
+#
+# The trust-boundary docstring above is ENFORCED, not just declared: every
+# connection opens with a 32-byte HMAC-SHA256 preamble keyed by a
+# per-cluster shared secret; a peer that cannot produce it is disconnected
+# before any frame is unpickled. The secret comes from
+# CADENCE_TPU_WIRE_SECRET (explicit per-cluster deployment), falling back
+# to a 0600 per-user secret file — so on a multi-user host, reaching the
+# port is not enough: an unrelated local user cannot read the key material.
+
+_HELLO_CTX = b"cadence-tpu-wire-v1"
+_HELLO_LEN = hashlib.sha256().digest_size
+_SECRET_CACHE: Optional[bytes] = None
+
+
+def cluster_secret() -> bytes:
+    global _SECRET_CACHE
+    if _SECRET_CACHE is not None:
+        return _SECRET_CACHE
+    env = os.environ.get("CADENCE_TPU_WIRE_SECRET")
+    if env:
+        _SECRET_CACHE = env.encode("utf-8")
+        return _SECRET_CACHE
+    path = os.path.join(os.path.expanduser("~"), ".cadence_tpu_wire_secret")
+    try:
+        with open(path, "rb") as fh:
+            _SECRET_CACHE = fh.read()
+            return _SECRET_CACHE
+    except FileNotFoundError:
+        pass
+    secret = os.urandom(32)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(secret)
+    except FileExistsError:
+        with open(path, "rb") as fh:  # lost the creation race: theirs wins
+            secret = fh.read()
+    _SECRET_CACHE = secret
+    return secret
+
+
+def _hello_mac() -> bytes:
+    return hmac.new(cluster_secret(), _HELLO_CTX, hashlib.sha256).digest()
+
+
+def send_hello(sock: socket.socket) -> None:
+    """Client side of the preamble: first bytes on every connection."""
+    sock.sendall(_hello_mac())
+
+
+def verify_hello(sock: socket.socket) -> None:
+    """Server side: read+check the preamble BEFORE the first pickle load.
+    Raises WireError (and the caller drops the connection) on mismatch."""
+    mac = _read_exact(sock, _HELLO_LEN)
+    if not hmac.compare_digest(mac, _hello_mac()):
+        raise WireError("unauthenticated peer (bad cluster secret)")
 
 
 def send_frame(sock: socket.socket, obj: Any) -> None:
@@ -61,6 +123,7 @@ def call(address: Tuple[str, int], request: Any, timeout: float = 30.0) -> Any:
     carrying the service-level type (ShardOwnershipLostError & co) across
     the process boundary."""
     with socket.create_connection(address, timeout=timeout) as sock:
+        send_hello(sock)
         send_frame(sock, request)
         kind, payload = recv_frame(sock)
     if kind == "err":
@@ -80,6 +143,7 @@ class Connection:
         if self._sock is None:
             self._sock = socket.create_connection(self.address,
                                                   timeout=self.timeout)
+            send_hello(self._sock)
         return self._sock
 
     def call(self, request: Any) -> Any:

@@ -172,3 +172,94 @@ class TestFailoverRaces:
         with pytest.raises(EntityNotExistsError):
             clusters.standby.stores.execution.get_current_run_id(
                 child_id, "wf-chi-race")
+
+
+class TestRedeliveryDedup:
+    """At-least-once result-leg failures must not corrupt the source
+    workflow (advisor r4 medium/low: redelivered start-child and signal)."""
+
+    def test_redelivered_start_child_with_same_request_id_reports_started(
+            self, clusters):
+        """start_workflow committed but on_child_started failed transiently:
+        the redelivery must match the existing run's create request id and
+        report STARTED (the reference's StartRequestID dedup arm), not
+        record StartChildWorkflowExecutionFailed for a child that runs."""
+        from cadence_tpu.engine.crosscluster import (
+            KIND_START_CHILD, CrossClusterTask)
+
+        parent_id, child_id = _ids(clusters)
+        task = CrossClusterTask(
+            kind=KIND_START_CHILD, source_domain_id=parent_id,
+            source_workflow_id="wf-dd-par", source_run_id="run-dd",
+            event_id=5, target_domain_id=child_id,
+            target_workflow_id="wf-dd-chi", workflow_type="t",
+            task_list=TL, parent_initiated_id=5,
+            create_request_id="req-dd-1")
+
+        applied = {}
+        proc = clusters.cross_cluster_processor
+
+        class _Source:
+            def on_child_started(self, d, w, r, eid, child_run):
+                applied["started"] = child_run
+
+            def on_child_start_failed(self, d, w, r, eid):
+                applied["failed"] = True
+
+        proc.source_router = lambda wf: _Source()
+        proc._execute(task)          # first delivery: child starts
+        proc._execute(task)          # redelivery: same create request id
+        assert "failed" not in applied
+        assert applied["started"] == (
+            clusters.standby.stores.execution.get_current_run_id(
+                child_id, "wf-dd-chi"))
+
+    def test_redelivered_start_child_different_request_id_reports_failed(
+            self, clusters):
+        """A DIFFERENT creator holds the workflow id: genuine
+        already-started — the parent gets the Failed event."""
+        from cadence_tpu.engine.crosscluster import (
+            KIND_START_CHILD, CrossClusterTask)
+
+        parent_id, child_id = _ids(clusters)
+        clusters.standby.frontend.start_workflow_execution(
+            "xc-child", "wf-dd2", "t", TL)
+        task = CrossClusterTask(
+            kind=KIND_START_CHILD, source_domain_id=parent_id,
+            source_workflow_id="wf-dd2-par", source_run_id="run-dd2",
+            event_id=5, target_domain_id=child_id,
+            target_workflow_id="wf-dd2", workflow_type="t",
+            task_list=TL, parent_initiated_id=5,
+            create_request_id="req-other")
+        applied = {}
+        proc = clusters.cross_cluster_processor
+
+        class _Source:
+            def on_child_started(self, d, w, r, eid, child_run):
+                applied["started"] = child_run
+
+            def on_child_start_failed(self, d, w, r, eid):
+                applied["failed"] = True
+
+        proc.source_router = lambda wf: _Source()
+        proc._execute(task)
+        assert applied == {"failed": True}
+
+    def test_signal_request_id_dedups_redelivery(self, clusters):
+        """The same signal request id applied twice appends ONE
+        WorkflowExecutionSignaled event."""
+        parent_id, child_id = _ids(clusters)
+        clusters.standby.frontend.start_workflow_execution(
+            "xc-child", "wf-sig-dd", "t", TL)
+        eng = clusters.standby.route("wf-sig-dd")
+        eng.signal_workflow(child_id, "wf-sig-dd", "ping",
+                            request_id="sig-req-1")
+        eng.signal_workflow(child_id, "wf-sig-dd", "ping",
+                            request_id="sig-req-1")
+        run = clusters.standby.stores.execution.get_current_run_id(
+            child_id, "wf-sig-dd")
+        events = clusters.standby.stores.history.read_events(
+            child_id, "wf-sig-dd", run)
+        signals = [e for e in events
+                   if e.event_type == EventType.WorkflowExecutionSignaled]
+        assert len(signals) == 1

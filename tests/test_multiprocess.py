@@ -169,3 +169,53 @@ class TestWireApiSurface:
         report = Batcher(fe, rps=100).run(
             DOMAIN, "WorkflowType = 'orders'", "signal", signal_name="x")
         assert report.total == 0
+
+
+class TestWireAuth:
+    """The wire trust boundary is enforced: a peer without the cluster
+    secret is dropped before any frame is unpickled (advisor r4)."""
+
+    def test_unauthenticated_peer_is_rejected(self):
+        import socket
+        import struct
+
+        from cadence_tpu.engine.persistence import Stores
+        from cadence_tpu.rpc.storeserver import StoreServer
+        from cadence_tpu.rpc.wire import call
+
+        import threading
+
+        server = StoreServer(("127.0.0.1", 0), Stores())
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            addr = ("127.0.0.1", server.server_address[1])
+            # authenticated path works
+            assert call(addr, ("ping",)) == "pong"
+            # raw connection with NO preamble: a pickle frame is never
+            # processed — the server hangs up instead of answering
+            with socket.create_connection(addr, timeout=5) as sock:
+                body = b"garbage-no-hello"
+                sock.sendall(struct.pack(">I", len(body)) + body)
+                sock.settimeout(2)
+                try:
+                    data = sock.recv(1024)
+                except (TimeoutError, OSError):
+                    data = b""
+                assert data == b""  # dropped, no response frame
+            # wrong secret: a forged 32-byte preamble + a well-formed
+            # frame is dropped without a response
+            import pickle
+
+            with socket.create_connection(addr, timeout=5) as sock:
+                sock.sendall(b"\x00" * 32)
+                body = pickle.dumps(("ping",))
+                sock.sendall(struct.pack(">I", len(body)) + body)
+                sock.settimeout(2)
+                try:
+                    data = sock.recv(1024)
+                except (TimeoutError, OSError):
+                    data = b""
+                assert data == b""
+            assert call(addr, ("ping",)) == "pong"
+        finally:
+            server.shutdown()

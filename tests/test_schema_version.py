@@ -83,3 +83,46 @@ class TestSchemaVersion:
         run = stores.execution.get_current_run_id(domain_id, "wf-m")
         ms = stores.execution.get_workflow(domain_id, "wf-m", run)
         assert ms.execution_info.close_status == CloseStatus.Completed
+
+    def test_recovery_stamps_midfile_header_no_remigration(self, tmp_path):
+        """Recovering a v1 log stamps a CURRENT version header so records
+        appended afterwards are not re-migrated; positional migration
+        lifts only the pre-header prefix (advisor r4)."""
+        import cadence_tpu.engine.durability as dur
+
+        wal = str(tmp_path / "mid.jsonl")
+        with open(wal, "w") as f:
+            f.write(json.dumps({"t": "d", "id": "d-1", "name": DOMAIN,
+                                "ret": 3, "act": True, "ac": "primary",
+                                "cl": ["primary"], "fv": 0, "nv": 0}) + "\n")
+        stores, _ = recover_stores(wal, verify_on_device=False,
+                                   rebuild_on_device=False)
+        stores.wal.close()
+        records = DurableLog.read_all(wal)
+        # mid-file header appended by recovery; file now reads as current
+        assert records[-1] == {"t": "ver", "v": WAL_VERSION}
+        assert wal_version(records) == WAL_VERSION
+        # positional migration: prefix lifts, post-header records pass
+        # through untouched even with a destructive migration registered
+        calls = []
+        orig = dict(dur._MIGRATIONS)
+
+        def _spy(rec):
+            calls.append(rec.get("t"))
+            return orig[1](rec)
+
+        dur._MIGRATIONS[1] = _spy
+        try:
+            body, original = dur.migrate_records(
+                records + [{"t": "d", "id": "d-2", "name": "post", "ret": 1,
+                            "act": True, "ac": "primary", "cl": ["primary"],
+                            "fv": 0, "nv": 0, "st": 0, "desc": "",
+                            "arc": ""}])
+        finally:
+            dur._MIGRATIONS.update(orig)
+        assert original == WAL_VERSION
+        assert calls == ["d"]  # ONLY the v1 prefix record was migrated
+        # second recovery still sees the domain exactly once
+        stores2, _ = recover_stores(wal, verify_on_device=False,
+                                    rebuild_on_device=False)
+        assert stores2.domain.by_name(DOMAIN).retention_days == 3
