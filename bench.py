@@ -79,15 +79,20 @@ def _hbm_peak(compiled) -> dict:
 
 def _suite_table(trials: int, suite_workflows: int, layout):
     """Host-encoded corpora (the product's replay configuration): distinct
-    histories, wire32 lanes, replay + checksum on device, 4B/wf pulled."""
+    histories, wirec-compressed lanes (~10-18 B/event, ops/wirec.py)
+    decoded on device, replay + checksum on device, 4B/wf pulled. The
+    wire32 transfer rate is kept as the uncompressed comparison point."""
     import jax
 
     from cadence_tpu.gen.corpus import SUITES, generate_corpus
     from cadence_tpu.ops.encode import LANE_EVENT_ID, encode_corpus, to_wire32
+    from cadence_tpu.ops.wirec import pack_wirec
     from cadence_tpu.parallel.mesh import (
         make_mesh,
         replay_sharded_crc,
+        replay_wirec_sharded_crc,
         shard_events32,
+        shard_wirec,
     )
 
     mesh = make_mesh()
@@ -98,36 +103,56 @@ def _suite_table(trials: int, suite_workflows: int, layout):
                                     seed=20260730, target_events=120)
         events_np = encode_corpus(histories)
         real = int((events_np[:, :, LANE_EVENT_ID] > 0).sum())
+        t0 = time.perf_counter()
+        corpus = pack_wirec(events_np)
+        t_pack = time.perf_counter() - t0
         wire = to_wire32(events_np)
-        events = shard_events32(wire, mesh)
 
-        def run_once(ev):
-            crc, errors, stats = replay_sharded_crc(ev, mesh, layout)
+        def run_resident(parts):
+            from cadence_tpu.parallel.mesh import _replay_wirec_crc_with_stats
+            crc, errors, _ = _replay_wirec_crc_with_stats(
+                *parts, corpus.profile, layout)
             return np.asarray(crc), np.asarray(errors)
 
-        crcs, errors = run_once(events)  # compile + warm
+        parts = shard_wirec(corpus, mesh)
+        crcs, errors = run_resident(parts)  # compile + warm
         rates = []
         for _ in range(trials):
             t0 = time.perf_counter()
-            run_once(events)
+            run_resident(parts)
             rates.append(real / (time.perf_counter() - t0) / n_devices)
-        # transfer-inclusive: the SAME replay with the H2D copy timed.
-        # On tunneled hosts this measures the link, and says so.
+        # transfer-inclusive: the SAME replay with the H2D copy of the
+        # COMPRESSED corpus timed. On tunneled hosts this measures the
+        # link, and says so — wirec's whole point is shrinking this leg.
         t0 = time.perf_counter()
-        run_once(shard_events32(wire, mesh))
+        crc_x, err_x, _ = replay_wirec_sharded_crc(corpus, mesh, layout)
+        np.asarray(crc_x)
         t_xfer = time.perf_counter() - t0
+        # uncompressed comparison: the r04 configuration
+        t0 = time.perf_counter()
+        crc_w, _, _ = replay_sharded_crc(shard_events32(wire, mesh), mesh,
+                                         layout)
+        crc_w = np.asarray(crc_w)
+        t_xfer32 = time.perf_counter() - t0
         table[suite] = {
             "workflows": suite_workflows,
             "distinct_histories": True,
             "events": real,
-            "wire_format": "int32x20",
+            "wire_format": "wirec",
+            "bytes_per_event": round(corpus.bytes_per_event(), 2),
+            "pack_s": round(t_pack, 3),
             "rate_min": round(min(rates)),
             "rate_median": round(statistics.median(rates)),
             "rate_max": round(max(rates)),
             "transfer_included_rate": round(real / t_xfer / n_devices),
-            "h2d_bytes": int(wire.nbytes),
+            "transfer_included_rate_wire32": round(
+                real / t_xfer32 / n_devices),
+            "h2d_bytes": int(corpus.wire_bytes),
+            "h2d_bytes_wire32": int(wire.nbytes),
             "error_workflows": int((errors != 0).sum()),
             "crc_xor": int(np.bitwise_xor.reduce(crcs.astype(np.uint32))),
+            "crc_parity_wire32": bool(
+                (crc_w == crcs.astype(np.uint32)).all()),
         }
     return table
 
@@ -252,25 +277,37 @@ def _north_star(workflows: int, max_events: int, chunk: int, seed: int,
 
 
 def _feeder_rate(layout):
-    """The wire32 ingest pipeline: wire bytes → C++ int32 packer → H2D →
-    device replay+checksum → 4B/wf back."""
+    """The ingest pipeline: wire bytes → C++ packer → wirec compression →
+    H2D → device decode+replay+checksum → 4B/wf back; the wire32
+    (uncompressed) sustained rate is kept as the comparison point."""
     from cadence_tpu.gen.corpus import generate_corpus
     from cadence_tpu.native import packing
-    from cadence_tpu.native.feeder import feed_corpus32
+    from cadence_tpu.native.feeder import feed_corpus32, feed_corpus_wirec
 
     if not packing.native_available():
         return None
-    histories = generate_corpus("basic", num_workflows=4096, seed=7,
+    histories = generate_corpus("basic", num_workflows=16384, seed=7,
                                 target_events=100)
-    feed_corpus32(histories[:1024], chunk_workflows=1024, layout=layout)  # warm
-    _, errors, report = feed_corpus32(histories, chunk_workflows=1024,
-                                      layout=layout)
+    chunk = 8192
+    feed_corpus_wirec(histories[:chunk], chunk_workflows=chunk,
+                      layout=layout)  # warm
+    _, errors, report = feed_corpus_wirec(histories, chunk_workflows=chunk,
+                                          layout=layout)
+    feed_corpus32(histories[:chunk], chunk_workflows=chunk,
+                  layout=layout)  # warm
+    _, errors32, report32 = feed_corpus32(histories, chunk_workflows=chunk,
+                                          layout=layout)
     return {
-        "wire_format": "int32x20",
+        "wire_format": "wirec",
         "events": report.events,
         "sustained_events_per_sec": round(report.events_per_sec),
         "pack_only_events_per_sec": round(report.pack_events_per_sec),
+        "compress_s": round(report.compress_s, 3),
+        "bytes_per_event": round(report.bytes_per_event, 2),
+        "profile_refits": report.profile_refits,
         "error_workflows": int((errors != 0).sum()),
+        "wire32_sustained_events_per_sec": round(report32.events_per_sec),
+        "wire32_error_workflows": int((errors32 != 0).sum()),
     }
 
 

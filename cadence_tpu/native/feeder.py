@@ -30,6 +30,10 @@ class FeedReport:
     chunks: int = 0
     wall_s: float = 0.0
     pack_s: float = 0.0
+    #: wirec pipeline only: host compression cost and wire density
+    compress_s: float = 0.0
+    wire_bytes: int = 0
+    profile_refits: int = 0
 
     @property
     def events_per_sec(self) -> float:
@@ -38,6 +42,10 @@ class FeedReport:
     @property
     def pack_events_per_sec(self) -> float:
         return self.events / self.pack_s if self.pack_s else 0.0
+
+    @property
+    def bytes_per_event(self) -> float:
+        return self.wire_bytes / self.events if self.events else 0.0
 
 
 def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
@@ -118,6 +126,64 @@ def feed_serialized32(blobs: Sequence[bytes], max_events: int,
                  replay_to_crc32)
 
 
+def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
+                          chunk_workflows: int = 4096,
+                          layout: PayloadLayout = DEFAULT_LAYOUT,
+                          num_threads: Optional[int] = None
+                          ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
+    """The COMPRESSED ingest pipeline: wire bytes → C++ int64 packer →
+    numpy wirec compression (~10-18 B/event, ops/wirec.py) → H2D → device
+    decode+replay+checksum → 4 bytes/workflow back.
+
+    The wirec profile is measured on the FIRST chunk and pinned so every
+    chunk shares one executable; a later chunk whose values fall outside
+    the pinned widths triggers a refit (recompute + recompile) — counted
+    in the report, never silent."""
+    import jax
+
+    from ..ops.replay import replay_wirec_to_crc
+    from ..ops.wirec import ProfileMisfit, pack_wirec
+
+    total = len(blobs)
+    report = FeedReport(workflows=total)
+    depth = 2
+    buffers = [np.empty((chunk_workflows, max_events, packing.NUM_LANES),
+                        dtype=np.int64) for _ in range(depth)]
+    profile = None
+    start = time.perf_counter()
+    device_outs: List[Tuple] = []
+    for ci, lo in enumerate(range(0, total, chunk_workflows)):
+        if ci >= depth:
+            jax.block_until_ready(device_outs[ci - depth])
+        chunk = list(blobs[lo:lo + chunk_workflows])
+        pad = chunk_workflows - len(chunk)
+        if pad:
+            chunk.extend([_EMPTY_BLOB] * pad)
+        t0 = time.perf_counter()
+        packed = packing.pack_serialized(chunk, max_events,
+                                         num_threads=num_threads,
+                                         out=buffers[ci % depth])
+        report.pack_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        try:
+            corpus = pack_wirec(packed, profile=profile)
+        except ProfileMisfit:
+            corpus = pack_wirec(packed)  # refit: fresh plan, recompile
+            report.profile_refits += 1
+        profile = corpus.profile
+        report.compress_s += time.perf_counter() - t0
+        report.events += int(corpus.n_events.sum())
+        report.wire_bytes += corpus.wire_bytes
+        device_outs.append(replay_wirec_to_crc(
+            jax.device_put(corpus.slab), jax.device_put(corpus.bases),
+            jax.device_put(corpus.n_events), profile, layout))
+        report.chunks += 1
+    first = np.concatenate([np.asarray(r) for r, _ in device_outs])[:total]
+    errors = np.concatenate([np.asarray(e) for _, e in device_outs])[:total]
+    report.wall_s = time.perf_counter() - start
+    return first, errors, report
+
+
 def feed_corpus(histories, chunk_workflows: int = 4096,
                 layout: PayloadLayout = DEFAULT_LAYOUT,
                 max_events: int = 0
@@ -144,3 +210,18 @@ def feed_corpus32(histories, chunk_workflows: int = 4096,
         max_events = max(history_length(h) for h in histories)
     return feed_serialized32(serialize_corpus(histories), max_events,
                              chunk_workflows, layout)
+
+
+def feed_corpus_wirec(histories, chunk_workflows: int = 4096,
+                      layout: PayloadLayout = DEFAULT_LAYOUT,
+                      max_events: int = 0
+                      ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
+    """Convenience: serialize + feed a corpus through the compressed
+    wirec pipeline."""
+    from ..core.codec import serialize_corpus
+    from ..ops.encode import history_length
+
+    if max_events <= 0:
+        max_events = max(history_length(h) for h in histories)
+    return feed_serialized_wirec(serialize_corpus(histories), max_events,
+                                 chunk_workflows, layout)

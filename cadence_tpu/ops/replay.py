@@ -117,6 +117,49 @@ def replay_to_crc32(events32: jnp.ndarray,
     return crc32_rows(payload_rows(s, layout)), s.error
 
 
+@partial(jax.jit, static_argnames=("profile", "layout"))
+def replay_wirec(slab: jnp.ndarray, bases: jnp.ndarray,
+                 n_events: jnp.ndarray, profile,
+                 layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:
+    """Replay a wirec-compressed corpus ([W, E, B] uint8 slab +
+    per-workflow bases/counts, ops/wirec.py): each scan step decodes ONE
+    event column in registers — delta lanes ride the scan carry, so the
+    dense int64 tensor never materializes in HBM and only the compressed
+    bytes ever cross the host link."""
+    from .wirec import decode_step, delta_base_columns
+
+    W, E, _ = slab.shape
+    s0 = init_state(W, layout)
+    cols = delta_base_columns(profile)
+    prev0 = (bases[:, list(cols)] if cols
+             else jnp.zeros((W, 0), dtype=jnp.int64))
+
+    def body(carry, xs):
+        s, prev = carry
+        sl, e_idx = xs
+        ev, prev = decode_step(sl, prev, bases, n_events, e_idx, profile)
+        return (step(s, ev), prev), None
+
+    (s, _), _ = jax.lax.scan(
+        body, (s0, prev0),
+        (jnp.swapaxes(slab, 0, 1), jnp.arange(E, dtype=n_events.dtype)))
+    return s
+
+
+@partial(jax.jit, static_argnames=("profile", "layout"))
+def replay_wirec_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
+                        n_events: jnp.ndarray, profile,
+                        layout: PayloadLayout = DEFAULT_LAYOUT
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """wirec replay reduced to (crc32 [W] uint32, error [W]): the
+    minimal-transfer product path — ~10-18 compressed bytes/event up,
+    4 bytes/workflow down."""
+    from .crc import crc32_rows
+
+    s = replay_wirec(slab, bases, n_events, profile, layout)
+    return crc32_rows(payload_rows(s, layout)), s.error
+
+
 def replay_corpus(histories: Sequence[Sequence[HistoryBatch]],
                   layout: PayloadLayout = DEFAULT_LAYOUT,
                   max_events: int = 0,

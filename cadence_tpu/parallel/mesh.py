@@ -101,3 +101,36 @@ def replay_sharded_crc(events32: jnp.ndarray, mesh: Mesh,
     lanes in, 4 bytes/workflow out, checksum computed on chip."""
     events32 = shard_events32(events32, mesh)
     return _replay_crc_with_stats(events32, layout)
+
+
+@partial(jax.jit, static_argnames=("profile", "layout"))
+def _replay_wirec_crc_with_stats(slab, bases, n_events, profile,
+                                 layout: PayloadLayout):
+    from ..ops.crc import crc32_rows
+    from ..ops.replay import replay_wirec
+
+    s = replay_wirec(slab, bases, n_events, profile, layout)
+    rows = payload_rows(s, layout)
+    stats = jnp.stack([
+        (s.error != 0).sum().astype(jnp.int64),
+        (s.close_status != 0).sum().astype(jnp.int64),
+    ])
+    return crc32_rows(rows), s.error, stats
+
+
+def shard_wirec(corpus, mesh: Mesh):
+    """Place a WirecCorpus's arrays with W partitioned over 'shard'."""
+    w_spec = lambda nd: NamedSharding(mesh, P(SHARD_AXIS, *([None] * (nd - 1))))
+    return (jax.device_put(corpus.slab, w_spec(3)),
+            jax.device_put(corpus.bases, w_spec(2)),
+            jax.device_put(corpus.n_events, w_spec(1)))
+
+
+def replay_wirec_sharded_crc(corpus, mesh: Mesh,
+                             layout: PayloadLayout = DEFAULT_LAYOUT
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """SPMD wirec replay: the compressed slab (~10-18 B/event) is what
+    crosses the host link; decode + replay + CRC all on device."""
+    slab, bases, n_events = shard_wirec(corpus, mesh)
+    return _replay_wirec_crc_with_stats(slab, bases, n_events,
+                                        corpus.profile, layout)
