@@ -41,6 +41,13 @@ class DomainNotActiveError(Exception):
         self.active_cluster = active_cluster
         self.current_cluster = current_cluster
 
+    def __reduce__(self):
+        # pickle-safe across the wire: default exception reduction passes
+        # self.args (the formatted message) to __init__, whose signature
+        # is the three fields — reconstruct from those instead
+        return (DomainNotActiveError,
+                (self.domain, self.active_cluster, self.current_cluster))
+
 
 def require_active(info, local_cluster: str) -> None:
     """Active-cluster gate for mutating APIs on GLOBAL domains

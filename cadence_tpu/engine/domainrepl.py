@@ -72,6 +72,12 @@ class DomainReplicationProcessor:
         self.target = target_stores
         self.local_cluster = local_cluster
         self._cursor = 0
+        #: optional hook(task, became_active) fired after an APPLIED task;
+        #: `became_active` is True when this apply flipped the domain
+        #: active onto THIS cluster — the standby-promotion trigger (the
+        #: wire hosts run the task-refresher sweep off it, the analog of
+        #: failover_watcher.go reacting to the metadata change)
+        self.on_applied = None
 
     def process_once(self) -> int:
         """Drain the stream to the tail (all pages); returns tasks
@@ -102,8 +108,14 @@ class DomainReplicationProcessor:
             existing = self.target.domain.by_id(task.domain_id)
         except EntityNotExistsError:
             self.target.domain.register(info)
+            if self.on_applied is not None:
+                self.on_applied(task, info.is_active)
             return True
         if existing.notification_version >= task.notification_version:
             return False  # stale replay (at-least-once queue)
         self.target.domain.update(info)
+        if self.on_applied is not None:
+            became_active = (info.is_active
+                             and existing.active_cluster != self.local_cluster)
+            self.on_applied(task, became_active)
         return True

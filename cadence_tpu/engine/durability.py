@@ -274,6 +274,11 @@ def queue_record(queue: str, payload) -> dict:
     return {"t": "q", "q": queue, "k": kind, "p": body}
 
 
+def queue_ack_record(queue: str, consumer: str, index: int) -> dict:
+    """Consumer ack level (persistence/queue.go UpdateAckLevel analog)."""
+    return {"t": "qa", "q": queue, "c": consumer, "i": index}
+
+
 def _repl_task_dict(task) -> dict:
     return {"d": task.domain_id, "w": task.workflow_id, "r": task.run_id,
             "f": task.first_event_id, "n": task.next_event_id,
@@ -401,6 +406,8 @@ def recover_stores(path: str, verify_on_device: bool = True,
                 rec["d"], rec["w"],
                 CurrentExecution(run_id=rec["r"], state=rec["st"],
                                  close_status=rec["cs"]))
+        elif t == "qa":
+            stores.queue.set_ack(rec["q"], rec["c"], rec["i"])
         elif t == "q":
             if rec["k"] == "task":
                 stores.queue.enqueue(rec["q"], _repl_task_from(rec["p"]))

@@ -715,6 +715,11 @@ class QueueStore:
         self._lock = threading.Lock()
         self._wal = None
         self._queues: Dict[str, List[object]] = {}
+        #: (queue, consumer) → ack index. The reference persists these as
+        #: per-cluster QueueMetadata ack levels (persistence/queue.go
+        #: UpdateAckLevel); a restarted or re-elected consumer resumes
+        #: from here instead of re-applying the whole stream.
+        self._acks: Dict[Tuple[str, str], int] = {}
 
     def enqueue(self, queue: str, payload: object) -> int:
         with self._lock:
@@ -730,6 +735,32 @@ class QueueStore:
         with self._lock:
             q = self._queues.get(queue, [])
             return [(i, q[i]) for i in range(from_index, min(len(q), from_index + count))]
+
+    def size(self, queue: str) -> int:
+        with self._lock:
+            return len(self._queues.get(queue, []))
+
+    def set_ack(self, queue: str, consumer: str, index: int) -> None:
+        """Monotonic: concurrent consumers (a leadership flap) can only
+        advance the level, never rewind it."""
+        with self._lock:
+            key = (queue, consumer)
+            if index <= self._acks.get(key, -1):
+                return
+            self._acks[key] = index
+            if self._wal is not None:
+                from .durability import queue_ack_record
+                self._wal.append(queue_ack_record(queue, consumer, index))
+
+    def get_ack(self, queue: str, consumer: str) -> int:
+        """The next index the consumer should read (0 when never acked)."""
+        with self._lock:
+            return self._acks.get((queue, consumer), -1) + 1
+
+    def ack_levels(self, queue: str) -> Dict[str, int]:
+        """consumer → acked index, the admin/DescribeQueue surface."""
+        with self._lock:
+            return {c: i for (q, c), i in self._acks.items() if q == queue}
 
 
 class ShardTaskQueues:

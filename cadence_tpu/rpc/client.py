@@ -111,6 +111,78 @@ class RemoteEngine:
         return _RemoteMethod(self._pool, self._workflow_id, method)
 
 
+class _RoutedMethod:
+    """Dotted method path issued as an engine_routed op (any live host of
+    the TARGET CLUSTER forwards to its ring's owner)."""
+
+    def __init__(self, cluster: "RemoteCluster", workflow_id: str,
+                 path: str) -> None:
+        self._cluster = cluster
+        self._workflow_id = workflow_id
+        self._path = path
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _RoutedMethod(self._cluster, self._workflow_id,
+                             f"{self._path}.{name}")
+
+    def __call__(self, *args, **kwargs):
+        return self._cluster._call_routed(self._workflow_id, self._path,
+                                          args, kwargs)
+
+
+class RemoteCluster:
+    """A PEER CLUSTER reached through its store server: live hosts are
+    discovered from the peer's heartbeat table (no static host config —
+    the cluster-group yaml's rpcAddress plus membership, collapsed), and
+    engine calls enter through any live host's engine_routed op.
+
+    Reference: common/rpc/outbounds.go crossDCCaller + cluster-group
+    config (config/development_xdc_cluster0.yaml:71-94)."""
+
+    def __init__(self, store_address: Tuple[str, int],
+                 peer_ttl: float = 3.0) -> None:
+        self.store_address = store_address
+        self.stores = RemoteStores(store_address)
+        self.peer_ttl = peer_ttl
+        self._host_pools: dict = {}
+
+    def live_host_pools(self):
+        """One _Pool per live peer host, preferring already-open pools."""
+        peers = self.stores.peers(self.peer_ttl)
+        pools = []
+        for host, port in peers:
+            key = ("127.0.0.1", port)
+            if key not in self._host_pools:
+                self._host_pools[key] = _Pool(key)
+            pools.append(self._host_pools[key])
+        return pools
+
+    def _call_routed(self, workflow_id: str, path: str, args, kwargs):
+        last: Exception = ConnectionError(
+            f"no live hosts behind store {self.store_address}")
+        for pool in self.live_host_pools():
+            try:
+                return pool.call(("engine_routed", workflow_id, path,
+                                  args, kwargs))
+            except (ConnectionError, OSError) as exc:
+                # entry host died between heartbeat and call: next one
+                last = exc
+        raise last
+
+    def engine(self, workflow_id: str) -> "_RoutedMethod":
+        """An engine proxy routed via any live host of this cluster."""
+
+        class _Root:
+            def __getattr__(_self, method: str):
+                if method.startswith("_"):
+                    raise AttributeError(method)
+                return _RoutedMethod(self, workflow_id, method)
+
+        return _Root()
+
+
 class RemoteMatching:
     """Matching proxy for task lists owned by another host. Long polls
     travel as a server-side blocking op (the gRPC long-poll analog), so no

@@ -142,8 +142,161 @@ def _wait_listening(port: int, proc: subprocess.Popen,
     raise TimeoutError(f"port {port} not serving after {timeout}s")
 
 
+class WireBox:
+    """One wire cluster seen through the FailoverManager/worker 'box'
+    duck type: .cluster_name, .frontend, .stores, .route — all backed by
+    sockets (the in-process Onebox surface, served remotely)."""
+
+    def __init__(self, name: str, cluster: Cluster) -> None:
+        from .client import RemoteCluster, RemoteStores
+
+        self.cluster_name = name
+        self.wire = cluster
+        self.frontend = cluster.frontend(0)
+        self.stores = RemoteStores(("127.0.0.1", cluster.store_port))
+        self._remote = RemoteCluster(("127.0.0.1", cluster.store_port))
+
+    def route(self, workflow_id: str):
+        return self._remote.engine(workflow_id)
+
+    # -- Onebox pump-surface shims (TaskPoller.drain compatibility): the
+    # -- service hosts run their own pump threads, so a client-side pump
+    # -- tick is just a short yield to let them progress
+    def pump_once(self) -> int:
+        time.sleep(0.05)
+        return 0
+
+    class _NoBacklog:
+        @staticmethod
+        def backlog() -> int:
+            return 0
+
+    matching = _NoBacklog()
+
+
+class ClusterGroup:
+    """A multi-cluster group of real wire clusters (two store servers,
+    N service hosts each; replication/domain/cross-cluster consumers
+    poll peers over sockets — the XDC deployment of
+    docker-compose-multiclusters + development_xdc_cluster{0,1}.yaml).
+
+    Exposes the same .active/.standby/.replicate* surface the in-process
+    ReplicatedClusters offers, so FailoverManager runs against real
+    processes unchanged — except replicate() here WAITS for the hosts'
+    own pumps to drain (consumers run in the service hosts, not in this
+    client)."""
+
+    DRAIN_TIMEOUT_S = 30.0
+
+    def __init__(self, clusters: Dict[str, Cluster]) -> None:
+        from ..engine.cluster import ClusterMetadata
+
+        self.clusters = clusters
+        self.meta = ClusterMetadata(cluster_names=tuple(sorted(clusters)))
+        self.boxes = {name: WireBox(name, c) for name, c in clusters.items()}
+
+    @property
+    def active(self) -> WireBox:
+        return self.boxes["primary"]
+
+    @property
+    def standby(self) -> WireBox:
+        return self.boxes["standby"]
+
+    def register_global_domain(self, name: str,
+                               retention_days: int = 1) -> str:
+        """Register on the active side only; domain replication carries it
+        to every peer (worker/replicator). Blocks until the peers have it."""
+        domain_id = self.active.frontend.register_domain(
+            name, retention_days=retention_days, is_active=True,
+            clusters=self.meta.cluster_names, active_cluster="primary",
+            failover_version=self.meta.initial_failover_version("primary"))
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT_S
+        others = [b for n, b in self.boxes.items() if n != "primary"]
+        while time.monotonic() < deadline:
+            if all(self._has_domain(b, name) for b in others):
+                return domain_id
+            time.sleep(0.05)
+        raise TimeoutError(f"domain {name} never replicated to peers")
+
+    @staticmethod
+    def _has_domain(box: WireBox, name: str) -> bool:
+        try:
+            box.stores.domain.by_name(name)
+            return True
+        except Exception:
+            return False
+
+    # -- drain waits (the hosts' leader pumps do the actual work) ----------
+
+    def _wait_consumed(self, src: str, dst: str, queue: str,
+                      ack_key: str) -> None:
+        tail = self.boxes[src].stores.queue.size(queue)
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT_S
+        while time.monotonic() < deadline:
+            ack = self.boxes[dst].stores.queue.get_ack(ack_key, dst)
+            if ack >= tail:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"{dst} consumed {ack}/{tail} of {src}'s {queue}")
+
+    def replicate(self) -> int:
+        from ..engine.replication import REPLICATION_QUEUE
+
+        self._wait_consumed("primary", "standby", REPLICATION_QUEUE,
+                            "repl-from:primary")
+        return 0
+
+    def replicate_reverse(self) -> int:
+        from ..engine.replication import REPLICATION_QUEUE
+
+        self._wait_consumed("standby", "primary", REPLICATION_QUEUE,
+                            "repl-from:standby")
+        return 0
+
+    def replicate_domains(self) -> int:
+        from ..engine.domainrepl import DOMAIN_REPLICATION_QUEUE
+
+        self._wait_consumed("primary", "standby", DOMAIN_REPLICATION_QUEUE,
+                            "domainrepl-from:primary")
+        self._wait_consumed("standby", "primary", DOMAIN_REPLICATION_QUEUE,
+                            "domainrepl-from:standby")
+        return 0
+
+    def stop(self) -> None:
+        for c in self.clusters.values():
+            c.stop()
+
+
+def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
+                 num_shards: int = 8, hb_interval: float = 0.15,
+                 ttl: float = 3.0) -> ClusterGroup:
+    """Launch a multi-cluster group: per cluster one store server + N
+    service hosts, every host configured with the peer clusters' store
+    addresses (the cluster-group config) so its leader runs the inbound
+    replication/domain/cross-cluster consumers against real sockets."""
+    store_ports = {name: free_port() for name in cluster_names}
+    clusters: Dict[str, Cluster] = {}
+    try:
+        for name in cluster_names:
+            peers = [f"{p}=127.0.0.1:{store_ports[p]}"
+                     for p in cluster_names if p != name]
+            clusters[name] = launch(
+                num_hosts=num_hosts, num_shards=num_shards,
+                hb_interval=hb_interval, ttl=ttl, cluster_name=name,
+                store_port=store_ports[name], peer_specs=peers)
+    except Exception:
+        for c in clusters.values():
+            c.stop()
+        raise
+    return ClusterGroup(clusters)
+
+
 def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
-           hb_interval: float = 0.15, ttl: float = 3.0) -> Cluster:
+           hb_interval: float = 0.15, ttl: float = 3.0,
+           cluster_name: str = "primary", store_port: int = 0,
+           peer_specs=()) -> Cluster:
     """Spawn the store server + `num_hosts` service hosts as OS processes.
     The TTL must comfortably exceed worst-case heartbeat jitter (a
     GIL-starved beat thread on a loaded host): a too-tight TTL makes the
@@ -155,7 +308,7 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
         os.path.abspath(__file__))))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 
-    store_port = free_port()
+    store_port = store_port or free_port()
     store_cmd = [sys.executable, "-m", "cadence_tpu.rpc.storeserver",
                  "--port", str(store_port)]
     if wal:
@@ -166,13 +319,16 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
     hosts: Dict[str, int] = {}
     procs: Dict[str, subprocess.Popen] = {}
     for i in range(num_hosts):
-        name = f"host-{i}"
+        name = f"{cluster_name}-host-{i}" if peer_specs else f"host-{i}"
         port = free_port()
         cmd = [sys.executable, "-m", "cadence_tpu.rpc.server",
                "--name", name, "--port", str(port),
                "--store", f"127.0.0.1:{store_port}",
                "--num-shards", str(num_shards),
-               "--hb-interval", str(hb_interval), "--ttl", str(ttl)]
+               "--hb-interval", str(hb_interval), "--ttl", str(ttl),
+               "--cluster-name", cluster_name]
+        for spec in peer_specs:
+            cmd += ["--peer", spec]
         procs[name] = subprocess.Popen(cmd, env=env)
         hosts[name] = port
     for name, port in hosts.items():

@@ -29,6 +29,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..engine.controller import ShardController, ShardNotOwnedError
+from ..engine.crosscluster import CrossClusterProcessor
 from ..engine.frontend import Frontend
 from ..engine.history_engine import HistoryEngine
 from ..engine.matching import MatchingEngine
@@ -85,6 +86,49 @@ class RoutedMatching:
         return invoke
 
 
+class _XdcConsumer:
+    """One peer cluster's inbound machinery: history replication, domain
+    metadata, and the two cross-cluster task directions."""
+
+    def __init__(self, name, cluster, repl, domain, xc) -> None:
+        self.name = name
+        self.cluster = cluster
+        self.repl = repl
+        self.domain = domain
+        self.xc = xc
+
+
+class _WireCrossClusterProcessor(CrossClusterProcessor):
+    """CrossClusterProcessor whose RESULT leg routes by the source
+    domain's CURRENT active cluster (looked up in the local, replicated
+    domain table): locally-active sources apply through the ring;
+    remotely-active ones go back through the peer's engine_routed door.
+    The reference's cross_cluster_task_processor responds through the
+    source cluster's history client the same way."""
+
+    def __init__(self, source_stores, target_router, local_cluster,
+                 target_stores, host: "ServiceHost") -> None:
+        super().__init__(source_stores, target_router, None, local_cluster,
+                         target_stores=target_stores)
+        self._host = host
+
+    def _source_engine(self, task):
+        host = self._host
+        active = None
+        try:
+            active = host.stores.domain.by_id(
+                task.source_domain_id).active_cluster
+        except Exception:
+            pass
+        if active is None or active == host.cluster_name:
+            return host.route(task.source_workflow_id)
+        consumer = next((c for c in host._xdc_consumers
+                         if c.name == active), None)
+        if consumer is None:  # unknown cluster: try any peer
+            consumer = host._xdc_consumers[0]
+        return consumer.cluster.engine(task.source_workflow_id)
+
+
 class ServiceHost(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
@@ -92,7 +136,9 @@ class ServiceHost(socketserver.ThreadingTCPServer):
     def __init__(self, name: str, address: Tuple[str, int],
                  store_address: Tuple[str, int], num_shards: int,
                  hb_interval: float = 0.15, ttl: float = 3.0,
-                 pump_interval: float = 0.05) -> None:
+                 pump_interval: float = 0.05,
+                 cluster_name: str = "primary",
+                 peers: Optional[Dict[str, Tuple[str, int]]] = None) -> None:
         super().__init__(address, _Handler)
         from ..utils.dynamicconfig import DynamicConfig
         from ..utils.metrics import MetricsRegistry
@@ -103,9 +149,16 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.num_shards = num_shards
         self.hb_interval = hb_interval
         self.ttl = ttl
+        self.cluster_name = cluster_name
+        #: peer cluster name → its STORE server address (the cluster-group
+        #: config, development_xdc_cluster0.yaml:71-94 analog)
+        self.peers = dict(peers or {})
         self.clock = RealTimeSource()
         self.config = DynamicConfig()
         self.metrics = MetricsRegistry()
+        #: shared across every engine this host creates (multi-cluster
+        #: replication publish seam)
+        self._publisher_holder: Dict[str, object] = {"pub": None}
         #: name → (host, port) of every live peer (incl. self)
         self._peer_addresses: Dict[str, Tuple[str, int]] = {
             name: ("127.0.0.1", address[1])}
@@ -116,12 +169,17 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.matching = RoutedMatching(self)
         self.frontend = Frontend(self.stores, self.matching, self.route,
                                  config=self.config, metrics=self.metrics,
-                                 time_source=self.clock)
+                                 time_source=self.clock,
+                                 cluster_name=cluster_name)
         self.processors = QueueProcessors(self.controller, self.matching,
                                           self.stores, self.clock,
                                           router=self.route,
                                           metrics=self.metrics,
-                                          config=self.config)
+                                          config=self.config,
+                                          cluster_name=cluster_name)
+        self._xdc_consumers = []
+        if self.peers:
+            self._wire_cluster_group()
         # the production pump is the N-worker pool (per-domain fairness,
         # redispatch, contiguous acks — engine/tasks.py); store round-trips
         # are I/O the workers overlap
@@ -140,7 +198,116 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         engine = HistoryEngine(shard, self.stores, self.clock)
         engine.metrics = self.metrics
         engine.config = self.config
+        engine.replication_publisher_holder = self._publisher_holder
         return engine
+
+    # -- cluster group (XDC over the wire) ---------------------------------
+
+    def _wire_cluster_group(self) -> None:
+        """Compose this host into its cluster group: outbound — engines
+        publish committed batches and domain mutations onto the LOCAL
+        store's replication queues; inbound — per-peer consumers poll the
+        PEER'S store server over sockets and apply here (the remote-poller
+        shape of replication/task_fetcher.go + worker/replicator). Ack
+        levels persist in the local store, so the pumps survive host death
+        and leadership moves (persistence/queue.go UpdateAckLevel)."""
+        from ..engine.crosscluster import CrossClusterPublisher
+        from ..engine.domainrepl import (
+            DomainReplicationProcessor,
+            DomainReplicationPublisher,
+        )
+        from ..engine.replication import (
+            HistoryReplicator,
+            ReplicationPublisher,
+            ReplicationTaskProcessor,
+        )
+        from .client import RemoteCluster
+
+        self._publisher_holder["pub"] = ReplicationPublisher(self.stores)
+        self.frontend.domain_replication_publisher = (
+            DomainReplicationPublisher(self.stores))
+        self.processors.cross_cluster_publisher = (
+            CrossClusterPublisher(self.stores))
+
+        for peer_name, store_addr in self.peers.items():
+            peer = RemoteCluster(store_addr, peer_ttl=self.ttl)
+
+            def read_peer_history(domain_id, workflow_id, run_id,
+                                  from_id, to_id, _peer=peer):
+                batches = _peer.stores.history.as_history_batches(
+                    domain_id, workflow_id, run_id)
+                return [b for b in batches
+                        if from_id <= b.events[0].id < to_id]
+
+            repl = ReplicationTaskProcessor(
+                HistoryReplicator(self.stores),
+                ReplicationPublisher(peer.stores), self.stores,
+                source_history_reader=read_peer_history)
+            repl.metrics = self.metrics
+            domain = DomainReplicationProcessor(peer.stores, self.stores,
+                                                self.cluster_name)
+            domain.on_applied = self._on_domain_replicated
+            xc_peer = _WireCrossClusterProcessor(
+                peer.stores, self.route, self.cluster_name,
+                target_stores=self.stores, host=self)
+            xc_self = _WireCrossClusterProcessor(
+                self.stores, self.route, self.cluster_name,
+                target_stores=self.stores, host=self)
+            self._xdc_consumers.append(
+                _XdcConsumer(peer_name, peer, repl, domain,
+                             (xc_peer, xc_self)))
+
+    def _on_domain_replicated(self, task, became_active: bool) -> None:
+        """Standby promotion: a replicated flip that makes a domain active
+        HERE regenerates its outstanding tasks from mutable state (the
+        failover_watcher → RefreshTasks path; without it, pre-failover
+        pending work never runs on the new active side)."""
+        if not became_active:
+            return
+        try:
+            from ..engine.task_refresher import sweep_refresh
+            sweep_refresh(self.stores, self.route, task.domain_id)
+        except Exception:
+            from ..utils.log import DEFAULT_LOGGER
+            DEFAULT_LOGGER.error("promotion task refresh failed",
+                                 component="xdc", domain=task.name)
+
+    def _pump_xdc(self) -> None:
+        """One inbound-replication tick. Leader-gated: the host owning
+        shard 0 runs the cluster's consumers (leadership follows the ring;
+        persisted acks make handoff seamless). Ack levels load before and
+        persist after each pass, monotonic under leadership flaps."""
+        if 0 not in self.controller.owned_shards():
+            return
+        me = self.cluster_name
+        for c in self._xdc_consumers:
+            q = self.stores.queue
+            try:
+                ack_key = f"repl-from:{c.name}"
+                c.repl.ack_index = max(c.repl.ack_index,
+                                       q.get_ack(ack_key, me))
+                if c.repl.process_once():
+                    q.set_ack(ack_key, me, c.repl.ack_index - 1)
+            except Exception:
+                pass  # peer briefly unreachable; next tick retries
+            try:
+                dkey = f"domainrepl-from:{c.name}"
+                c.domain._cursor = max(c.domain._cursor,
+                                       q.get_ack(dkey, me))
+                c.domain.process_once()
+                if c.domain._cursor > 0:
+                    q.set_ack(dkey, me, c.domain._cursor - 1)
+            except Exception:
+                pass
+            for tag, xc in (("peer", c.xc[0]), ("self", c.xc[1])):
+                try:
+                    xkey = f"xc-from:{c.name}:{tag}"
+                    xc._cursor = max(xc._cursor, q.get_ack(xkey, me))
+                    xc.process_once()
+                    if xc._cursor > 0:
+                        q.set_ack(xkey, me, xc._cursor - 1)
+                except Exception:
+                    pass
 
     def route(self, workflow_id: str):
         """History router: local engine when this host owns the shard,
@@ -193,6 +360,8 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                 self.processors.process_timers_once()
             except Exception:
                 continue  # shard moved mid-pump etc.; next tick retries
+            if self._xdc_consumers:
+                self._pump_xdc()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -240,6 +409,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif op == "engine":
                     _, workflow_id, path, args, kwargs = req
                     target = server.controller.engine_for_workflow(workflow_id)
+                    for part in path.split("."):
+                        target = getattr(target, part)
+                    result = target(*args, **kwargs)
+                elif op == "engine_routed":
+                    # cross-CLUSTER entry: any host accepts and forwards to
+                    # its ring's owner (server.route), so a peer cluster
+                    # needs only one live address, not our ring topology
+                    _, workflow_id, path, args, kwargs = req
+                    target = server.route(workflow_id)
                     for part in path.split("."):
                         target = getattr(target, part)
                     result = target(*args, **kwargs)
@@ -296,11 +474,20 @@ def main(argv=None) -> int:
     p.add_argument("--num-shards", type=int, default=8)
     p.add_argument("--hb-interval", type=float, default=0.15)
     p.add_argument("--ttl", type=float, default=3.0)
+    p.add_argument("--cluster-name", default="primary")
+    p.add_argument("--peer", action="append", default=[],
+                   help="peer cluster as NAME=STOREHOST:PORT (repeatable)")
     args = p.parse_args(argv)
     shost, sport = args.store.rsplit(":", 1)
+    peers = {}
+    for spec in args.peer:
+        pname, paddr = spec.split("=", 1)
+        ph, pp = paddr.rsplit(":", 1)
+        peers[pname] = (ph, int(pp))
     host = ServiceHost(args.name, ("127.0.0.1", args.port),
                        (shost, int(sport)), args.num_shards,
-                       hb_interval=args.hb_interval, ttl=args.ttl)
+                       hb_interval=args.hb_interval, ttl=args.ttl,
+                       cluster_name=args.cluster_name, peers=peers)
     host.start()
     threading.Event().wait()  # serve until killed
     return 0
