@@ -91,7 +91,11 @@ class QueueProcessors:
         scheduler.dead (counted, never silently dropped)."""
         from .faults import TransientStoreError
         from .persistence import ConditionFailedError, ShardOwnershipLostError
-        from .tasks import AckManager, RetryableTaskError
+        from .tasks import (
+            AckManager,
+            EnvironmentalTaskError,
+            RetryableTaskError,
+        )
 
         if not hasattr(self, "_transfer_acks"):
             self._transfer_acks = {}
@@ -112,8 +116,14 @@ class QueueProcessors:
                         t=task):
                     try:
                         self._execute_transfer(e, d, w, r, t)
+                    except ConnectionError as exc:
+                        # a dead/partitioned peer is ENVIRONMENTAL: the
+                        # task must outlive the membership TTL window, or
+                        # a dispatch dead-lettered mid-steal is a lost
+                        # decision nothing recovers
+                        raise EnvironmentalTaskError(str(exc))
                     except (ShardOwnershipLostError, ConditionFailedError,
-                            TransientStoreError, ConnectionError) as exc:
+                            TransientStoreError) as exc:
                         raise RetryableTaskError(str(exc))
 
                 scheduler.submit(domain_id, job,

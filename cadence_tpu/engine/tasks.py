@@ -70,6 +70,22 @@ class RetryableTaskError(Exception):
     """Executor failure that should redispatch (transient store/RPC)."""
 
 
+class EnvironmentalTaskError(RetryableTaskError):
+    """Failure caused by the ENVIRONMENT, not the task — a dead peer, a
+    partitioned store. Retries with backoff WITHOUT consuming the task's
+    bounded attempts: the condition resolves when the membership ring
+    re-routes (TTL), and a dispatch task dead-lettered inside that window
+    is a lost decision/activity that nothing ever recovers. Matches the
+    reference's redispatcher, which requeues such tasks for as long as
+    the shard is owned. A high separate cap (ENV_MAX_ATTEMPTS) still
+    backstops a permanently-wedged environment."""
+
+
+#: environmental retries outlast any ring TTL by a wide margin (~100s at
+#: the 1s backoff cap) while still bounding a truly wedged environment
+ENV_MAX_ATTEMPTS = 100
+
+
 class TaskScheduler:
     """Worker pool with per-key round-robin fairness + redispatch.
 
@@ -152,6 +168,22 @@ class TaskScheduler:
             key, (fn, on_done, attempt) = item
             try:
                 fn()
+            except EnvironmentalTaskError:
+                if attempt + 1 >= ENV_MAX_ATTEMPTS:
+                    self._kill(key, fn, "environmental retries exhausted")
+                else:
+                    import time as _time
+                    ready_at = _time.monotonic() + min(
+                        self.retry_delay * (2 ** min(attempt, 10)), 1.0)
+                    with self._lock:
+                        if not self._stopping:
+                            import heapq
+                            self._delay_seq += 1
+                            heapq.heappush(self._delayed,
+                                           (ready_at, self._delay_seq, key,
+                                            fn, on_done, attempt + 1))
+                            self._work.notify()
+                    on_done = None
             except RetryableTaskError:
                 if attempt + 1 >= self.max_attempts:
                     # attempts exhausted with real backoff in between: DLQ

@@ -40,19 +40,26 @@ def group():
         g.stop()
 
 
-def _complete_one(fe, domain, workflow_id, deadline_s=20.0):
+def _complete_many(fe, domain, workflow_ids, deadline_s=30.0):
+    """Complete every workflow in the set, responding to WHATEVER task
+    arrives (discarding another workflow's polled task would strand it
+    until its decision timeout redelivers)."""
+    remaining = set(workflow_ids)
     deadline = time.monotonic() + deadline_s
-    while time.monotonic() < deadline:
+    while remaining and time.monotonic() < deadline:
         resp = fe.poll_for_decision_task(domain, TL, wait_seconds=0.5)
         if resp is None or resp.token is None:
-            continue
-        if resp.token.workflow_id != workflow_id:
             continue
         fe.respond_decision_task_completed(resp.token, [
             Decision(DecisionType.CompleteWorkflowExecution,
                      {"result": b"done"})])
-        return
-    raise TimeoutError(f"no decision task for {workflow_id}")
+        remaining.discard(resp.token.workflow_id)
+    if remaining:
+        raise TimeoutError(f"no decision task for {sorted(remaining)}")
+
+
+def _complete_one(fe, domain, workflow_id, deadline_s=20.0):
+    _complete_many(fe, domain, [workflow_id], deadline_s)
 
 
 def _standby_history(group, domain_id, workflow_id, deadline_s=25.0):
@@ -278,11 +285,13 @@ class TestWireKillDuringReplication:
         domain_id = group.register_global_domain("xw-kill")
         fe = group.active.frontend
         workflows = [f"wf-k{i}" for i in range(6)]
-        for wf in workflows:
-            fe.start_workflow_execution("xw-kill", wf, "t", TL)
-        # complete half BEFORE the kill so the stream is mid-flight
+        # complete half BEFORE the kill so the stream is mid-flight; the
+        # second half's starts land just before the kill
         for wf in workflows[:3]:
-            _complete_one(fe, "xw-kill", wf)
+            fe.start_workflow_execution("xw-kill", wf, "t", TL)
+        _complete_many(fe, "xw-kill", workflows[:3])
+        for wf in workflows[3:]:
+            fe.start_workflow_execution("xw-kill", wf, "t", TL)
 
         # kill the host the test's frontend is NOT connected to (the
         # frontend client pins host 0; the survivor serving through the
@@ -291,8 +300,7 @@ class TestWireKillDuringReplication:
         group.active.wire.kill_host(victim, signal.SIGKILL)
 
         # the survivor serves the rest (shards steal over TTL)
-        for wf in workflows[3:]:
-            _complete_one(fe, "xw-kill", wf, deadline_s=30.0)
+        _complete_many(fe, "xw-kill", workflows[3:], deadline_s=40.0)
 
         for wf in workflows:
             run, standby_batches = _standby_history(group, domain_id, wf,
