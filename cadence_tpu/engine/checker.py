@@ -78,12 +78,21 @@ def _validate_timer(a: dict) -> None:
              "a valid StartToFireTimeoutSeconds is not set on decision")
 
 
-def validate_decision(decision, wf_timeout: int) -> None:
+def validate_decision(decision, wf_timeout: int,
+                      blob_size_limit: int = 0) -> None:
     """Raise BadDecisionAttributes when the decision is malformed; may
     fill deduced defaults into decision.attrs (the reference mutates the
-    attributes the same way)."""
+    attributes the same way). `blob_size_limit` (when > 0) bounds every
+    bytes-valued attribute — the decision checker's blob-size arm
+    (decision/checker.go via common.CheckEventBlobSizeLimit)."""
     a = decision.attrs
     dt = decision.decision_type
+    if blob_size_limit:
+        for field, v in a.items():
+            if isinstance(v, (bytes, bytearray)) and len(v) > blob_size_limit:
+                _require(False, "BAD_BINARY",
+                         f"{field} payload {len(v)}B exceeds the "
+                         f"{blob_size_limit}B blob limit")
     if dt == DecisionType.ScheduleActivityTask:
         _validate_activity(a, wf_timeout)
     elif dt == DecisionType.StartTimer:

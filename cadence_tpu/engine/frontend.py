@@ -183,12 +183,17 @@ class Frontend:
                                  cron_schedule: str = "",
                                  first_decision_backoff: int = 0,
                                  retry_policy: Optional[RetryPolicy] = None,
+                                 input_payload: bytes = b"",
                                  ) -> str:
         from ..utils import metrics as m
         from .authorization import PERMISSION_WRITE
+        from .limits import check_blob_size
         self._authorize("StartWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_START)
         self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
+        check_blob_size(input_payload, self.config,
+                        "StartWorkflowExecution", domain,
+                        metrics=self.metrics)
         from .domain import require_active, require_startable
         info = self.stores.domain.by_name(domain)
         require_startable(info)
@@ -203,6 +208,7 @@ class Frontend:
             cron_schedule=cron_schedule,
             first_decision_backoff=first_decision_backoff,
             retry_policy=retry_policy,
+            input_payload=input_payload,
         )
 
     def signal_workflow_execution(self, domain: str, workflow_id: str,
@@ -492,11 +498,28 @@ class Frontend:
         domain_id = info.domain_id
         engine = self.router(workflow_id)
         from .persistence import EntityNotExistsError
+
+        def read_paged() -> List[HistoryEvent]:
+            # the full convenience read drives the RANGED store read in
+            # pages (state_rebuilder.go:114's paginated replay posture):
+            # no single store call moves unbounded bytes
+            from ..utils.dynamicconfig import KEY_HISTORY_PAGE_SIZE
+            cap = int(self.config.get(KEY_HISTORY_PAGE_SIZE, domain=domain))
+            out: List[HistoryEvent] = []
+            from_id = 1
+            while True:
+                page = self.stores.history.read_events_range(
+                    domain_id, workflow_id, run_id, from_id, cap)
+                out.extend(page)
+                if len(page) < cap:
+                    return out
+                from_id = page[-1].id + 1
+
         try:
             if run_id is None:
                 run_id = self.stores.execution.get_current_run_id(domain_id,
                                                                   workflow_id)
-            events = engine.get_history(domain_id, workflow_id, run_id)
+            events = read_paged()
         except EntityNotExistsError:
             # read-through to the archive: a retention-scavenged run whose
             # domain archives stays readable (common/archiver Get path).
@@ -518,8 +541,44 @@ class Frontend:
             # next_event_id reaches last_event_id + 2
             engine.notifier.wait_for((domain_id, workflow_id, run_id),
                                      last_event_id + 2, timeout=timeout)
-            events = engine.get_history(domain_id, workflow_id, run_id)
+            events = read_paged()
         return events
+
+    def get_workflow_execution_history_page(self, domain: str,
+                                            workflow_id: str,
+                                            run_id: Optional[str] = None,
+                                            page_size: int = 0,
+                                            next_page_token: Optional[bytes]
+                                            = None):
+        """Paginated history read (workflowHandler.go:3745-3811 getHistory
+        with nextPageToken): at most `page_size` events per call (the
+        configured default/cap bounds it), with an opaque resume token.
+        The store read itself is RANGED, so a page never moves more than
+        page_size events — the contract the CLI, the archiver, and any
+        long-history consumer page through."""
+        from ..utils.dynamicconfig import KEY_HISTORY_PAGE_SIZE
+        from .pagination import HistoryPage, decode_token, encode_token
+
+        cap = int(self.config.get(KEY_HISTORY_PAGE_SIZE, domain=domain))
+        page_size = min(page_size, cap) if page_size > 0 else cap
+        info = self.stores.domain.by_name(domain)
+        domain_id = info.domain_id
+        from_id = 1
+        if next_page_token:
+            tok = decode_token(next_page_token)
+            run_id = tok["run_id"]
+            from_id = int(tok["next_event_id"])
+        elif run_id is None:
+            run_id = self.stores.execution.get_current_run_id(domain_id,
+                                                              workflow_id)
+        events = self.stores.history.read_events_range(
+            domain_id, workflow_id, run_id, from_id, page_size + 1)
+        more = len(events) > page_size
+        events = events[:page_size]
+        token = (encode_token({"run_id": run_id,
+                               "next_event_id": events[-1].id + 1})
+                 if events and more else None)
+        return HistoryPage(events, token, run_id)
 
     def describe_workflow_execution(self, domain: str, workflow_id: str,
                                     run_id: Optional[str] = None
@@ -540,13 +599,35 @@ class Frontend:
                                  ) -> List[VisibilityRecord]:
         """ListWorkflowExecutions with a query (workflowHandler.go:2837):
         SQL-ish filters over built-in columns AND custom search attributes
-        (engine/visibility_query.py grammar)."""
+        (engine/visibility_query.py grammar). Index-planned: the query's
+        equality hints intersect the store's (type, status) indexes."""
         domain_id = self.stores.domain.by_name(domain).domain_id
         return self.stores.visibility.query(domain_id, query)
 
     # ScanWorkflowExecutions (workflowHandler.go:3200) shares semantics
     # with List in this store (no pagination-ordering split to preserve)
     scan_workflow_executions = list_workflow_executions
+
+    def list_workflow_executions_page(self, domain: str, query: str = "",
+                                      page_size: int = 0,
+                                      next_page_token: Optional[bytes] = None):
+        """Paginated List/Scan: StartTime-DESC pages with an opaque resume
+        token (the ES search_after token reframed onto the store's
+        time-ordered index)."""
+        from ..utils.dynamicconfig import KEY_VISIBILITY_PAGE_SIZE
+        from .pagination import VisibilityPage, decode_token, encode_token
+
+        cap = int(self.config.get(KEY_VISIBILITY_PAGE_SIZE, domain=domain))
+        page_size = min(page_size, cap) if page_size > 0 else cap
+        domain_id = self.stores.domain.by_name(domain).domain_id
+        cursor = (decode_token(next_page_token)["after"]
+                  if next_page_token else None)
+        records, raw = self.stores.visibility.query_page(
+            domain_id, query, page_size, cursor)
+        token = encode_token({"after": list(raw)}) if raw else None
+        return VisibilityPage(records, token)
+
+    scan_workflow_executions_page = list_workflow_executions_page
 
     def count_workflow_executions(self, domain: str, query: str = "") -> int:
         """CountWorkflowExecutions (workflowHandler.go:3322)."""

@@ -492,11 +492,15 @@ class HistoryEngine:
         # attribute validation FIRST (decision/checker.go): one malformed
         # decision fails the whole decision task with a typed cause and
         # the worker re-decides — never a replay-transaction crash
+        from ..utils.dynamicconfig import KEY_BLOB_SIZE_LIMIT_ERROR
         from .checker import BadDecisionAttributes, validate_decision
+        blob_limit = int(self.config.get(KEY_BLOB_SIZE_LIMIT_ERROR,
+                                         domain=ms.domain_entry.name) or 0)
         fail_cause = None
         try:
             for d in decisions:
-                validate_decision(d, info.workflow_timeout)
+                validate_decision(d, info.workflow_timeout,
+                                  blob_size_limit=blob_limit)
         except BadDecisionAttributes as bad:
             fail_cause = bad.cause
         if fail_cause is None and ms.buffered_events and any(
@@ -1462,6 +1466,40 @@ class HistoryEngine:
     # reads
     # ------------------------------------------------------------------
 
+    def _enforce_history_limits(self, ms: MutableState) -> None:
+        """History growth enforcement (the size_limit contract): past the
+        warn threshold the breach is logged+counted; past the error
+        threshold the run is TERMINATED — unbounded growth is how one
+        workflow takes down a shard (host/size_limit_test.go; the
+        reference enforces in workflowExecutionContext's transaction)."""
+        from ..utils import metrics as _m
+        from .limits import TERMINATE_REASON, history_limits
+
+        info = ms.execution_info
+        if info.state == WorkflowState.Completed:
+            return
+        count_warn, count_error, size_warn, size_error = history_limits(
+            self.config, ms.domain_entry.name)
+        count = info.next_event_id - 1
+        size = ms.history_size
+        if (count_error and count > count_error) or (
+                size_error and size > size_error):
+            self.metrics.inc("limits", "history-limit-terminations")
+            self.log.error("terminating run past history limit",
+                           workflow_id=info.workflow_id, events=count,
+                           history_size=size)
+            try:
+                self.terminate_workflow(info.domain_id, info.workflow_id,
+                                        info.run_id, reason=TERMINATE_REASON)
+            except (EntityNotExistsError, InvalidRequestError):
+                pass  # closed in the race; the limit's goal is met
+        elif (count_warn and count > count_warn) or (
+                size_warn and size > size_warn):
+            self.metrics.inc("limits", "history-limit-warnings")
+            self.log.warning("history above warn threshold",
+                             workflow_id=info.workflow_id, events=count,
+                             history_size=size)
+
     def get_mutable_state(self, domain_id: str, workflow_id: str,
                           run_id: Optional[str] = None) -> MutableState:
         ms, _ = self._load(domain_id, workflow_id, run_id)
@@ -1556,6 +1594,10 @@ class _Txn:
         # active transactions keep sticky execution state; only the true
         # replay paths clear it (state_builder.go:108)
         StateBuilder(self.ms, clear_sticky=False).apply_batch(batch)
+        # history-size accounting (mutableState GetHistorySize): the
+        # codec-serialized batch is what the store pays for this commit
+        from ..core.codec import serialize_history
+        self.ms.history_size += len(serialize_history([batch]))
         new_transfer = list(self.ms.transfer_tasks)
         new_timer = list(self.ms.timer_tasks)
         if self.drop_stale_decision_tasks:
@@ -1605,3 +1647,4 @@ class _Txn:
             info.next_event_id, info.state == _WS.Completed)
         for fn in self._post:
             fn()
+        self.engine._enforce_history_limits(self.ms)

@@ -261,6 +261,35 @@ class HistoryStore:
             for b in self.read_batches(domain_id, workflow_id, run_id, branch)
         ]
 
+    def read_events_range(self, domain_id: str, workflow_id: str,
+                          run_id: str, first_event_id: int,
+                          page_size: int,
+                          branch: Optional[int] = None) -> List[HistoryEvent]:
+        """Ranged read: up to `page_size` events with id >= first_event_id
+        (ReadHistoryBranch's paginated contract,
+        historyStore.ReadHistoryBranchRequest): the page bounds the
+        store→caller bytes — the reads GetWorkflowExecutionHistory and
+        the state rebuilder page through."""
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branches = self._branches.get(key)
+            if branches is None:
+                raise EntityNotExistsError(
+                    f"no history for {workflow_id}/{run_id}")
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                raise EntityNotExistsError(f"no branch {index} for {key}")
+            out: List[HistoryEvent] = []
+            for b in branches[index]:
+                if b and b[-1].id < first_event_id:
+                    continue
+                for e in b:
+                    if e.id >= first_event_id:
+                        out.append(e)
+                        if len(out) >= page_size:
+                            return out
+            return out
+
 
 # ---------------------------------------------------------------------------
 # Execution store (ExecutionManager, dataManagerInterfaces.go:1697)
@@ -624,9 +653,53 @@ class VisibilityRecord:
 
 
 class VisibilityStore:
+    """Indexed visibility (the ES tier reframed onto in-store indexes):
+    records partition by domain, with secondary indexes on workflow type
+    and close status, and a per-domain (start_time, wf, run)-ordered list
+    for time-ordered pagination. Query strings compile to a predicate
+    PLUS equality hints (visibility_query.compile_query_with_hints); the
+    planner intersects index sets from the hints before evaluating the
+    predicate, so selective List/Count never scans the domain — the
+    esql → index-lookup split without the ES dependency."""
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._records: Dict[Tuple[str, str, str], VisibilityRecord] = {}
+        #: domain → set of keys (domain partition)
+        self._by_domain: Dict[str, set] = {}
+        #: (domain, workflow_type) → set of keys
+        self._by_type: Dict[Tuple[str, str], set] = {}
+        #: (domain, close_status) → set of keys (-1 = open)
+        self._by_status: Dict[Tuple[str, int], set] = {}
+        #: domain → ascending [(start_time, workflow_id, run_id)]
+        self._ordered: Dict[str, List[tuple]] = {}
+
+    # -- index maintenance (held under self._lock) -------------------------
+
+    def _index_add_locked(self, rec: VisibilityRecord) -> None:
+        key = (rec.domain_id, rec.workflow_id, rec.run_id)
+        self._by_domain.setdefault(rec.domain_id, set()).add(key)
+        self._by_type.setdefault(
+            (rec.domain_id, rec.workflow_type), set()).add(key)
+        self._by_status.setdefault(
+            (rec.domain_id, rec.close_status), set()).add(key)
+        import bisect
+        bisect.insort(self._ordered.setdefault(rec.domain_id, []),
+                      (rec.start_time, rec.workflow_id, rec.run_id))
+
+    def _index_remove_locked(self, rec: VisibilityRecord) -> None:
+        key = (rec.domain_id, rec.workflow_id, rec.run_id)
+        self._by_domain.get(rec.domain_id, set()).discard(key)
+        self._by_type.get((rec.domain_id, rec.workflow_type),
+                          set()).discard(key)
+        self._by_status.get((rec.domain_id, rec.close_status),
+                            set()).discard(key)
+        order = self._ordered.get(rec.domain_id, [])
+        import bisect
+        entry = (rec.start_time, rec.workflow_id, rec.run_id)
+        i = bisect.bisect_left(order, entry)
+        if i < len(order) and order[i] == entry:
+            order.pop(i)
 
     def record_started(self, rec: VisibilityRecord) -> None:
         """Upsert the open-execution record. Under a CONCURRENT task pump
@@ -642,7 +715,9 @@ class VisibilityStore:
                 merged = dict(existing.search_attrs)
                 merged.update(rec.search_attrs)
                 rec.search_attrs = merged
+                self._index_remove_locked(existing)
             self._records[key] = rec
+            self._index_add_locked(rec)
 
     def record_closed(self, domain_id: str, workflow_id: str, run_id: str,
                       close_time: int, close_status: int,
@@ -659,18 +734,22 @@ class VisibilityStore:
                     run_id=run_id, workflow_type=workflow_type,
                     start_time=start_time)
                 self._records[(domain_id, workflow_id, run_id)] = rec
+            else:
+                self._index_remove_locked(rec)
             rec.close_time = close_time
             rec.close_status = close_status
+            self._index_add_locked(rec)
 
     def list_open(self, domain_id: str) -> List[VisibilityRecord]:
         with self._lock:
-            return [r for r in self._records.values()
-                    if r.domain_id == domain_id and r.close_status == -1]
+            keys = self._by_status.get((domain_id, -1), set())
+            return [self._records[k] for k in keys]
 
     def list_closed(self, domain_id: str) -> List[VisibilityRecord]:
         with self._lock:
-            return [r for r in self._records.values()
-                    if r.domain_id == domain_id and r.close_status != -1]
+            keys = (self._by_domain.get(domain_id, set())
+                    - self._by_status.get((domain_id, -1), set()))
+            return [self._records[k] for k in keys]
 
     def upsert_search_attributes(self, domain_id: str, workflow_id: str,
                                  run_id: str, attrs: Dict[str, object]) -> None:
@@ -681,15 +760,65 @@ class VisibilityStore:
             if rec is not None:
                 rec.search_attrs.update(attrs)
 
+    def _candidates_locked(self, domain_id: str, hints: dict):
+        """Index-reduced candidate key set (None = the whole domain)."""
+        sets = []
+        if "workflowtype" in hints:
+            sets.append(self._by_type.get(
+                (domain_id, hints["workflowtype"]), set()))
+        if "closestatus" in hints:
+            try:
+                status = int(hints["closestatus"])
+            except (TypeError, ValueError):
+                return set()
+            sets.append(self._by_status.get((domain_id, status), set()))
+        if not sets:
+            return None
+        out = sets[0]
+        for s in sets[1:]:
+            out = out & s
+        return out
+
     def query(self, domain_id: str, query: str) -> List[VisibilityRecord]:
-        """Query-filtered scan (ListWorkflowExecutions with `query`,
-        workflowHandler.go:2837; ES translation reframed as an evaluated
-        predicate — engine/visibility_query.py)."""
-        from .visibility_query import compile_query
-        pred = compile_query(query)
+        """Query-filtered list (ListWorkflowExecutions with `query`,
+        workflowHandler.go:2837): index intersection from the query's
+        equality hints, then the compiled predicate over the remainder."""
+        from .visibility_query import compile_query_with_hints
+        pred, hints = compile_query_with_hints(query)
         with self._lock:
-            return [r for r in self._records.values()
-                    if r.domain_id == domain_id and pred(r)]
+            cands = self._candidates_locked(domain_id, hints)
+            if cands is None:
+                cands = self._by_domain.get(domain_id, set())
+            return [r for r in (self._records[k] for k in cands) if pred(r)]
+
+    def query_page(self, domain_id: str, query: str, page_size: int,
+                   next_page_token=None):
+        """One page in StartTime-DESC order (the reference's sort), with
+        an opaque resume token: (records, next_token). The token is the
+        last returned record's order entry; None when the page ended the
+        result set."""
+        from .visibility_query import compile_query_with_hints
+        pred, hints = compile_query_with_hints(query)
+        out: List[VisibilityRecord] = []
+        with self._lock:
+            cands = self._candidates_locked(domain_id, hints)
+            order = self._ordered.get(domain_id, [])
+            import bisect
+            hi = (len(order) if next_page_token is None
+                  else bisect.bisect_left(order, tuple(next_page_token)))
+            i = hi - 1
+            while i >= 0 and len(out) < page_size:
+                st, wf, run = order[i]
+                key = (domain_id, wf, run)
+                if cands is None or key in cands:
+                    rec = self._records.get(key)
+                    if rec is not None and pred(rec):
+                        out.append(rec)
+                i -= 1
+            more = i >= 0 and len(out) == page_size
+        token = ((out[-1].start_time, out[-1].workflow_id, out[-1].run_id)
+                 if out and more else None)
+        return out, token
 
     def count(self, domain_id: str, query: str = "") -> int:
         """CountWorkflowExecutions (workflowHandler.go:3322)."""
@@ -702,7 +831,9 @@ class VisibilityStore:
     def delete_record(self, domain_id: str, workflow_id: str,
                       run_id: str) -> None:
         with self._lock:
-            self._records.pop((domain_id, workflow_id, run_id), None)
+            rec = self._records.pop((domain_id, workflow_id, run_id), None)
+            if rec is not None:
+                self._index_remove_locked(rec)
 
 
 # ---------------------------------------------------------------------------

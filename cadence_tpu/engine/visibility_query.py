@@ -108,26 +108,34 @@ class _Parser:
         return tok
 
     def parse(self) -> Callable[[VisibilityRecord], bool]:
-        pred = self.expr()
+        pred, self.hints = self.expr()
         if self.peek() is not None:
             raise QueryParseError(f"trailing tokens: {self.tokens[self.pos:]}")
         return pred
 
+    # Each production returns (pred, hints): hints is a {field: value}
+    # dict of EQUALITY constraints every matching record must satisfy —
+    # AND merges them, OR discards (a disjunction guarantees nothing).
+    # The store's query planner intersects index sets from these before
+    # evaluating the predicate (the esql → index-lookup split).
+
     def expr(self):
-        left = self.term()
+        left, hints = self.term()
         while self.peek() == ("bool", "OR"):
             self.take()
-            right = self.term()
+            right, _ = self.term()
             left = (lambda l, r: lambda rec: l(rec) or r(rec))(left, right)
-        return left
+            hints = {}
+        return left, hints
 
     def term(self):
-        left = self.factor()
+        left, hints = self.factor()
         while self.peek() == ("bool", "AND"):
             self.take()
-            right = self.factor()
+            right, rhints = self.factor()
             left = (lambda l, r: lambda rec: l(rec) and r(rec))(left, right)
-        return left
+            hints = {**hints, **rhints}
+        return left, hints
 
     def factor(self):
         kind, val = self.take()
@@ -167,12 +175,23 @@ class _Parser:
             except TypeError:
                 return False
 
-        return pred
+        hints = {field.lower(): value} if op == "=" else {}
+        return pred, hints
 
 
 def compile_query(query: str) -> Callable[[VisibilityRecord], bool]:
     """Compile a visibility query string into a record predicate."""
+    pred, _ = compile_query_with_hints(query)
+    return pred
+
+
+def compile_query_with_hints(query: str):
+    """(predicate, equality-hints): hints map lowercased field names to
+    values every matching record must carry — the store intersects its
+    (type, status) indexes from them before evaluating the predicate."""
     tokens = _tokenize(query)
     if not tokens:
-        return lambda rec: True  # empty query matches everything
-    return _Parser(tokens).parse()
+        return (lambda rec: True), {}
+    parser = _Parser(tokens)
+    pred = parser.parse()
+    return pred, parser.hints

@@ -34,6 +34,17 @@ KEY_RETENTION_DAYS_DEFAULT = "domain.defaultRetentionDays"
 KEY_FRONTEND_RPS = "frontend.rps"
 KEY_FRONTEND_DOMAIN_RPS = "frontend.domainRPS"
 KEY_FRONTEND_BURST = "frontend.burst"
+# size/count limits (decision/checker.go blob checks, size_limit_test.go
+# history growth enforcement); 0 disables a limit
+KEY_BLOB_SIZE_LIMIT_WARN = "limit.blobSizeWarn"
+KEY_BLOB_SIZE_LIMIT_ERROR = "limit.blobSizeError"
+KEY_HISTORY_COUNT_LIMIT_WARN = "limit.historyCountWarn"
+KEY_HISTORY_COUNT_LIMIT_ERROR = "limit.historyCountError"
+KEY_HISTORY_SIZE_LIMIT_WARN = "limit.historySizeWarn"
+KEY_HISTORY_SIZE_LIMIT_ERROR = "limit.historySizeError"
+# pagination: the default/maximum page any list-shaped API returns
+KEY_HISTORY_PAGE_SIZE = "limit.historyPageSize"
+KEY_VISIBILITY_PAGE_SIZE = "limit.visibilityPageSize"
 
 _DEFAULTS: Dict[str, Any] = {
     KEY_MAX_ACTIVITIES: 16,
@@ -49,6 +60,14 @@ _DEFAULTS: Dict[str, Any] = {
     KEY_FRONTEND_RPS: 0,          # 0 = unlimited
     KEY_FRONTEND_DOMAIN_RPS: 0,
     KEY_FRONTEND_BURST: 0,        # 0 = burst == rps
+    KEY_BLOB_SIZE_LIMIT_WARN: 256 * 1024,        # the reference's defaults
+    KEY_BLOB_SIZE_LIMIT_ERROR: 2 * 1024 * 1024,
+    KEY_HISTORY_COUNT_LIMIT_WARN: 150_000,
+    KEY_HISTORY_COUNT_LIMIT_ERROR: 200_000,
+    KEY_HISTORY_SIZE_LIMIT_WARN: 50 * 1024 * 1024,
+    KEY_HISTORY_SIZE_LIMIT_ERROR: 200 * 1024 * 1024,
+    KEY_HISTORY_PAGE_SIZE: 1000,
+    KEY_VISIBILITY_PAGE_SIZE: 1000,
 }
 
 

@@ -1,0 +1,196 @@
+"""Size/count limits + pagination (VERDICT r4 missing #3/#4).
+
+Reference: host/size_limit_test.go (history growth TERMINATES the run;
+oversized blobs are refused), workflowHandler.go:3745-3811 (paginated
+history with nextPageToken), the ES search_after tokens for List/Scan.
+"""
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType, EventType
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.limits import TERMINATE_REASON, LimitExceededError
+from cadence_tpu.engine.onebox import Onebox
+from cadence_tpu.models.deciders import EchoDecider
+from cadence_tpu.utils.dynamicconfig import (
+    KEY_BLOB_SIZE_LIMIT_ERROR,
+    KEY_BLOB_SIZE_LIMIT_WARN,
+    KEY_HISTORY_COUNT_LIMIT_ERROR,
+    KEY_HISTORY_COUNT_LIMIT_WARN,
+)
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "lim-domain"
+TL = "lim-tl"
+
+
+@pytest.fixture()
+def box():
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+class TestBlobSizeLimits:
+    def test_oversized_start_payload_refused(self, box):
+        box.config.set(KEY_BLOB_SIZE_LIMIT_ERROR, 1024)
+        with pytest.raises(LimitExceededError):
+            box.frontend.start_workflow_execution(
+                DOMAIN, "wf-blob", "t", TL, input_payload=b"x" * 2048)
+        # under the limit: accepted
+        box.frontend.start_workflow_execution(
+            DOMAIN, "wf-blob", "t", TL, input_payload=b"x" * 512)
+
+    def test_warn_threshold_counts_not_refuses(self, box):
+        box.config.set(KEY_BLOB_SIZE_LIMIT_WARN, 64)
+        box.config.set(KEY_BLOB_SIZE_LIMIT_ERROR, 10_000)
+        box.frontend.start_workflow_execution(
+            DOMAIN, "wf-warn", "t", TL, input_payload=b"x" * 128)
+        assert box.frontend.metrics.counter("limits", "blob-size-warnings") >= 1
+
+    def test_oversized_decision_result_fails_decision(self, box):
+        """A decision carrying a blob past the limit fails the DECISION
+        (BAD_BINARY cause), not the transaction — the worker re-decides
+        (decision/checker.go blob arm)."""
+        box.config.set(KEY_BLOB_SIZE_LIMIT_ERROR, 256)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-dec", "t", TL)
+
+        class OversizedDecider:
+            def __init__(self):
+                self.attempts = 0
+
+            def decide(self, history):
+                self.attempts += 1
+                if self.attempts == 1:
+                    return [Decision(DecisionType.CompleteWorkflowExecution,
+                                     {"result": b"x" * 1024})]
+                return [Decision(DecisionType.CompleteWorkflowExecution,
+                                 {"result": b"ok"})]
+
+        decider = OversizedDecider()
+        TaskPoller(box, DOMAIN, TL, {"wf-dec": decider}).drain()
+        did = box.frontend.describe_domain(DOMAIN).domain_id
+        run = box.stores.execution.get_current_run_id(did, "wf-dec")
+        events = box.stores.history.read_events(did, "wf-dec", run)
+        causes = [e.get("cause") for e in events
+                  if e.event_type == EventType.DecisionTaskFailed]
+        assert "BAD_BINARY" in causes
+        ms = box.stores.execution.get_workflow(did, "wf-dec", run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        assert decider.attempts >= 2
+
+
+class TestHistoryGrowthLimit:
+    def test_history_count_limit_terminates_run(self, box):
+        """The size_limit_test contract: a run whose history outgrows the
+        error threshold is TERMINATED by the engine, not left growing."""
+        box.config.set(KEY_HISTORY_COUNT_LIMIT_WARN, 10)
+        box.config.set(KEY_HISTORY_COUNT_LIMIT_ERROR, 16)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-grow", "t", TL)
+        did = box.frontend.describe_domain(DOMAIN).domain_id
+        # signals append events with no decision progress (buffered-free
+        # path: no inflight decision) until the limit trips
+        for i in range(30):
+            try:
+                box.frontend.signal_workflow_execution(DOMAIN, "wf-grow",
+                                                       f"s{i}")
+            except Exception:
+                break
+        run = box.stores.execution.get_current_run_id(did, "wf-grow")
+        ms = box.stores.execution.get_workflow(did, "wf-grow", run)
+        assert ms.execution_info.close_status == CloseStatus.Terminated
+        events = box.stores.history.read_events(did, "wf-grow", run)
+        term = [e for e in events
+                if e.event_type == EventType.WorkflowExecutionTerminated]
+        assert term and term[0].get("reason") == TERMINATE_REASON
+        assert box.metrics.counter("limits", "history-limit-terminations") >= 1
+        # the warn threshold fired on the way up
+        assert box.metrics.counter("limits", "history-limit-warnings") >= 1
+
+
+class TestHistoryPagination:
+    def test_pages_concatenate_to_full_history(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "wf-page", "echo", TL)
+        for i in range(8):
+            box.frontend.signal_workflow_execution(DOMAIN, "wf-page", f"s{i}")
+        did = box.frontend.describe_domain(DOMAIN).domain_id
+        run = box.stores.execution.get_current_run_id(did, "wf-page")
+        full = box.stores.history.read_events(did, "wf-page", run)
+        assert len(full) > 6
+        paged = []
+        token = None
+        pages = 0
+        while True:
+            page = box.frontend.get_workflow_execution_history_page(
+                DOMAIN, "wf-page", page_size=3, next_page_token=token)
+            paged.extend(page.events)
+            pages += 1
+            assert len(page.events) <= 3
+            if page.next_page_token is None:
+                break
+            token = page.next_page_token
+        assert pages >= 3
+        assert [e.id for e in paged] == [e.id for e in full]
+
+    def test_page_cap_bounds_default_reads(self, box):
+        from cadence_tpu.utils.dynamicconfig import KEY_HISTORY_PAGE_SIZE
+        box.config.set(KEY_HISTORY_PAGE_SIZE, 4)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-cap", "t", TL)
+        for i in range(6):
+            box.frontend.signal_workflow_execution(DOMAIN, "wf-cap", f"s{i}")
+        page = box.frontend.get_workflow_execution_history_page(
+            DOMAIN, "wf-cap", page_size=9999)
+        assert len(page.events) == 4  # the configured cap wins
+        assert page.next_page_token is not None
+
+
+class TestVisibilityPaginationAndIndex:
+    def _seed(self, box, n=12):
+        did = box.frontend.describe_domain(DOMAIN).domain_id
+        for i in range(n):
+            wf = f"wf-v{i}"
+            wtype = "orders" if i % 2 == 0 else "billing"
+            box.frontend.start_workflow_execution(DOMAIN, wf, wtype, TL)
+            TaskPoller(box, DOMAIN, TL, {wf: EchoDecider(TL)}).drain()
+        box.pump_until_quiet()
+        return did
+
+    def test_list_pages_are_disjoint_and_complete(self, box):
+        self._seed(box)
+        seen = []
+        token = None
+        while True:
+            page = box.frontend.list_workflow_executions_page(
+                DOMAIN, "WorkflowType = 'orders'", page_size=2,
+                next_page_token=token)
+            assert len(page.records) <= 2
+            seen.extend(r.workflow_id for r in page.records)
+            if page.next_page_token is None:
+                break
+            token = page.next_page_token
+        assert sorted(seen) == sorted(f"wf-v{i}" for i in range(0, 12, 2))
+        assert len(seen) == len(set(seen))  # disjoint pages
+
+    def test_index_prunes_candidates(self, box):
+        """The (type, status) indexes actually plan the query: a selective
+        type filter evaluates the predicate on the type's records only."""
+        did = self._seed(box)
+        store = box.stores.visibility
+        evaluated = []
+        from cadence_tpu.engine import visibility_query as vq
+        orig = vq.compile_query_with_hints
+
+        def spy(query):
+            pred, hints = orig(query)
+
+            def counting(rec):
+                evaluated.append(rec.workflow_id)
+                return pred(rec)
+            return counting, hints
+
+        vq.compile_query_with_hints, token = spy, None
+        try:
+            hits = store.query(did, "WorkflowType = 'billing'")
+        finally:
+            vq.compile_query_with_hints = orig
+        assert len(hits) == 6
+        assert len(evaluated) == 6  # only the billing index set, not all 12
