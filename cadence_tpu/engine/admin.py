@@ -111,12 +111,21 @@ class AdminHandler:
             except Exception:
                 continue
             shard = engine.shard
+            live = []
+            for proc in getattr(self.box, "processors", []):
+                states = proc.transfer_queue_states(shard_id)
+                if states:
+                    live = states
+                    break
             return {
                 "shard_id": shard_id,
                 "range_id": shard.range_id,
                 "transfer_ack_level": shard.transfer_ack_level,
                 "pending_transfer": len(shard.read_transfer_tasks(
                     shard.transfer_ack_level)),
+                # multi-level processing queues: live states when a
+                # concurrent pump runs here, else the persisted ones
+                "processing_queues": (live or shard.transfer_queue_states),
             }
         raise EntityNotExistsError(f"no live owner for shard {shard_id}")
 

@@ -238,9 +238,12 @@ def domain_record(info: DomainInfo) -> dict:
 
 
 def shard_record(info: ShardInfo) -> dict:
-    return {"t": "s", "id": info.shard_id, "o": info.owner,
-            "rg": info.range_id, "ta": info.transfer_ack_level,
-            "tm": info.timer_ack_level, "ra": info.replication_ack_level}
+    rec = {"t": "s", "id": info.shard_id, "o": info.owner,
+           "rg": info.range_id, "ta": info.transfer_ack_level,
+           "tm": info.timer_ack_level, "ra": info.replication_ack_level}
+    if info.transfer_queue_states:
+        rec["qs"] = [list(q) for q in info.transfer_queue_states]
+    return rec
 
 
 def current_run_record(domain_id: str, workflow_id: str,
@@ -379,7 +382,9 @@ def recover_stores(path: str, verify_on_device: bool = True,
             stores.shard.restore(ShardInfo(
                 shard_id=rec["id"], owner=rec["o"], range_id=rec["rg"],
                 transfer_ack_level=rec["ta"], timer_ack_level=rec["tm"],
-                replication_ack_level=rec["ra"]))
+                replication_ack_level=rec["ra"],
+                transfer_queue_states=[list(q)
+                                       for q in rec.get("qs", [])]))
         elif t == "h":
             batches = deserialize_history(
                 base64.b64decode(rec["blob"]), rec["d"], rec["w"], rec["r"])

@@ -64,6 +64,11 @@ class ShardInfo:
     timer_ack_level: int = 0  # nanos
     replication_ack_level: int = 0
     stolen_since_renew: int = 0
+    #: multi-level transfer processing-queue states (queue/interface.go
+    #: ProcessingQueueState persisted in shard info): entries of
+    #: [level, ack_level, domains|None, excluded_domains] — a new owner
+    #: resumes each level from ITS ack, not one global floor
+    transfer_queue_states: List[list] = field(default_factory=list)
 
 
 class ShardStore:

@@ -43,6 +43,107 @@ _CHILD_CLOSE_EVENT = {
 }
 
 
+class _ProcessingQueue:
+    """One level of a shard's transfer queue (queue/interface.go
+    ProcessingQueueState): its own ack manager and a domain filter —
+    either an allowlist (a split-off hot domain) or the base queue's
+    exclusion set. Reads, submissions, and ack advancement are all
+    per-queue, so a hot domain's backlog holds back only ITS level."""
+
+    def __init__(self, level: int, ack_level: int, domains=None,
+                 excluded=()) -> None:
+        from .tasks import AckManager
+        self.level = level
+        self.ack = AckManager(ack_level)
+        self.domains = frozenset(domains) if domains is not None else None
+        self.excluded = set(excluded)
+        self.drained = False
+        #: read cursor (the reference's read level): sweeps read FORWARD
+        #: from here so in-flight stragglers near the ack never clog the
+        #: window; resets to the persisted ack on restore, where the
+        #: register dedup absorbs the re-read
+        self.read_level = ack_level
+        #: in-flight task id → domain (split takes over a domain's
+        #: in-flight tasks from the base when it forms)
+        self.domain_of: dict = {}
+
+    def accepts(self, domain_id: str) -> bool:
+        if self.domains is not None:
+            return domain_id in self.domains
+        return domain_id not in self.excluded
+
+    def state(self) -> list:
+        return [self.level, self.ack.ack_level(),
+                sorted(self.domains) if self.domains is not None else None,
+                sorted(self.excluded)]
+
+
+class _ShardTransferQueues:
+    """A shard's processing-queue collection + split/merge policy
+    (queue/split_policy.go, transfer_queue_processor.go)."""
+
+    def __init__(self, persisted: list, base_ack: int) -> None:
+        if persisted:
+            self.queues = [_ProcessingQueue(lvl, ack, dom, exc)
+                           for lvl, ack, dom, exc in persisted]
+        else:
+            self.queues = [_ProcessingQueue(0, base_ack)]
+        #: per-domain tasks observed pending in the latest sweep
+        self.pending: dict = {}
+
+    @property
+    def base(self) -> _ProcessingQueue:
+        return self.queues[0]
+
+    def split(self, domain_id: str, max_level: int) -> bool:
+        """Move a hot domain onto its own level: a new queue starting at
+        the BASE ack (its unprocessed tasks are at or above it), the base
+        excluding the domain so its own ack can advance past the hot
+        backlog. The base RELEASES its in-flight tasks of that domain —
+        the split re-reads and owns them from here (at-least-once
+        executors make the duplicate window safe, the same window a
+        crash-redelivery opens)."""
+        if len(self.queues) >= max_level + 1:
+            return False
+        if any(q.domains and domain_id in q.domains for q in self.queues):
+            return False
+        split_ack = self.base.ack.ack_level()
+        self.base.excluded.add(domain_id)
+        for tid, dom in list(self.base.domain_of.items()):
+            if dom == domain_id:
+                self.base.ack.complete(tid)
+                self.base.domain_of.pop(tid, None)
+        self.queues.append(_ProcessingQueue(
+            len(self.queues), split_ack, {domain_id}))
+        return True
+
+    def merge_drained(self) -> int:
+        """Fold split queues back once safe: the split is DRAINED (no
+        reads pending, nothing in flight) and the base ack has caught up
+        past it — un-excluding earlier would re-deliver the range the
+        split already consumed."""
+        merged = 0
+        keep = [self.base]
+        for q in self.queues[1:]:
+            if (q.drained and q.ack.in_flight() == 0
+                    and self.base.ack.ack_level() >= q.ack.ack_level()):
+                self.base.excluded -= set(q.domains or ())
+                merged += 1
+            else:
+                keep.append(q)
+        if merged:
+            self.queues = keep
+            for i, q in enumerate(self.queues):
+                q.level = i
+        return merged
+
+    def min_ack(self) -> int:
+        return min(q.ack.ack_level() for q in self.queues)
+
+    def states(self) -> list:
+        return [q.state() for q in self.queues]
+
+
 class QueueProcessors:
     """Drains one controller's owned shards (active cluster side)."""
 
@@ -81,62 +182,128 @@ class QueueProcessors:
     # ------------------------------------------------------------------
 
     def process_transfer_concurrent(self, scheduler) -> int:
-        """N-worker transfer processing (parallelTaskProcessor +
-        weightedRoundRobin + redispatcher + ack manager): tasks submit to
-        the pool keyed by DOMAIN (per-domain fairness), complete out of
-        order, and each shard's persisted ack level advances only past the
-        contiguous completed prefix — a crash mid-pool never skips a
-        straggler. Transient failures raise RetryableTaskError inside the
-        job and redispatch with attempts; poison tasks land in
-        scheduler.dead (counted, never silently dropped)."""
+        """N-worker MULTI-LEVEL transfer processing (parallelTaskProcessor
+        + weightedRoundRobin + redispatcher + the processing-queue
+        collection of queue/transfer_queue_processor.go): each shard runs
+        a set of processing queues — level 0 for everyone, plus split-off
+        levels for hot domains — each with its own reads, its own ack
+        manager, and a persisted ack level. A domain whose observed
+        backlog exceeds the split threshold moves to its own level, so
+        its flood holds back only ITS ack while siblings' tasks keep
+        flowing and acking; drained splits merge back once the base ack
+        catches up. Tasks submit to the pool keyed by DOMAIN (fairness),
+        complete out of order, and each QUEUE's persisted level advances
+        only past its contiguous completed prefix — a crash mid-pool
+        never skips a straggler."""
+        from ..utils.dynamicconfig import (
+            KEY_QUEUE_BATCH_SIZE,
+            KEY_QUEUE_MAX_LEVEL,
+            KEY_QUEUE_SPLIT_THRESHOLD,
+        )
         from .faults import TransientStoreError
         from .persistence import ConditionFailedError, ShardOwnershipLostError
-        from .tasks import (
-            AckManager,
-            EnvironmentalTaskError,
-            RetryableTaskError,
-        )
+        from .tasks import EnvironmentalTaskError, RetryableTaskError
 
-        if not hasattr(self, "_transfer_acks"):
-            self._transfer_acks = {}
+        if not hasattr(self, "_transfer_queues"):
+            self._transfer_queues = {}
+        threshold = int(self.config.get(KEY_QUEUE_SPLIT_THRESHOLD))
+        max_level = int(self.config.get(KEY_QUEUE_MAX_LEVEL))
+        batch = int(self.config.get(KEY_QUEUE_BATCH_SIZE))
         submitted = 0
         for shard_id in self.controller.assigned_shards():
             engine = self.controller.engine_for_shard(shard_id)
             shard = engine.shard
-            ack = self._transfer_acks.get(shard_id)
-            if ack is None:
-                ack = self._transfer_acks[shard_id] = AckManager(
-                    shard.transfer_ack_level)
-            tasks = shard.read_transfer_tasks(ack.ack_level())
-            for task_id, domain_id, workflow_id, run_id, task in tasks:
-                if not ack.register(task_id):
-                    continue  # already in flight from a previous sweep
+            state = self._transfer_queues.get(shard_id)
+            if state is None:
+                state = self._transfer_queues[shard_id] = _ShardTransferQueues(
+                    shard.transfer_queue_states, shard.transfer_ack_level)
+            base_pending: dict = {}
+            for q in state.queues:
+                # the base window stretches to threshold+1 so a backlog
+                # big enough to warrant a split is actually observable
+                window = (max(batch, threshold + 1) if q.level == 0
+                          else batch)
+                read_from = max(q.ack.ack_level(), q.read_level)
+                tasks = shard.read_transfer_tasks(read_from, window)
+                accepted = 0
+                for task_id, domain_id, workflow_id, run_id, task in tasks:
+                    q.read_level = max(q.read_level, task_id)
+                    if not q.accepts(domain_id):
+                        if q.domains is None and q.ack.register(task_id):
+                            # base queue skips split-off domains but its
+                            # ack must advance past their rows
+                            q.ack.complete(task_id)
+                        continue
+                    accepted += 1
+                    if q.level == 0:
+                        base_pending[domain_id] = (
+                            base_pending.get(domain_id, 0) + 1)
+                    if not q.ack.register(task_id):
+                        continue  # already in flight from a previous sweep
+                    q.domain_of[task_id] = domain_id
 
-                def job(e=engine, d=domain_id, w=workflow_id, r=run_id,
-                        t=task):
-                    try:
-                        self._execute_transfer(e, d, w, r, t)
-                    except ConnectionError as exc:
-                        # a dead/partitioned peer is ENVIRONMENTAL: the
-                        # task must outlive the membership TTL window, or
-                        # a dispatch dead-lettered mid-steal is a lost
-                        # decision nothing recovers
-                        raise EnvironmentalTaskError(str(exc))
-                    except (ShardOwnershipLostError, ConditionFailedError,
-                            TransientStoreError) as exc:
-                        raise RetryableTaskError(str(exc))
+                    def job(e=engine, d=domain_id, w=workflow_id, r=run_id,
+                            t=task):
+                        try:
+                            self._execute_transfer(e, d, w, r, t)
+                        except ConnectionError as exc:
+                            # a dead/partitioned peer is ENVIRONMENTAL:
+                            # the task must outlive the membership TTL
+                            # window, or a dispatch dead-lettered
+                            # mid-steal is a lost decision nothing
+                            # recovers
+                            raise EnvironmentalTaskError(str(exc))
+                        except (ShardOwnershipLostError, ConditionFailedError,
+                                TransientStoreError) as exc:
+                            raise RetryableTaskError(str(exc))
 
-                scheduler.submit(domain_id, job,
-                                 on_done=lambda tid=task_id, a=ack:
-                                 a.complete(tid))
-                submitted += 1
-            level = ack.ack_level()
-            if level > shard.transfer_ack_level:
-                shard.update_transfer_ack_level(level)
+                    def done(tid=task_id, pq=q):
+                        pq.ack.complete(tid)
+                        pq.domain_of.pop(tid, None)
+
+                    scheduler.submit(domain_id, job, on_done=done)
+                    submitted += 1
+                q.drained = accepted == 0
+            # split policy: a domain dominating the base window past the
+            # threshold gets its own level (split_policy.go pending-count
+            # policy); merge drained splits the base has caught up past
+            for domain_id, n in base_pending.items():
+                if n > threshold and state.split(domain_id, max_level):
+                    from ..utils import metrics as m
+                    self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, "queue-splits")
+                    self.log_split(shard_id, domain_id, n)
+            merged = state.merge_drained()
+            if merged:
+                from ..utils import metrics as m
+                self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, "queue-merges",
+                                 merged)
+            state.pending = base_pending
+            new_states = state.states()
+            if new_states != getattr(state, "persisted", None):
+                try:
+                    shard.update_transfer_queue_states(new_states,
+                                                       state.min_ack())
+                    state.persisted = new_states
+                except ShardOwnershipLostError:
+                    self._transfer_queues.pop(shard_id, None)
+                except (TransientStoreError, ConnectionError):
+                    pass  # deferred: the next sweep re-persists
         from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, m.M_TASKS_PROCESSED,
                          submitted)
         return submitted
+
+    def log_split(self, shard_id: int, domain_id: str, pending: int) -> None:
+        from ..utils.log import DEFAULT_LOGGER
+        DEFAULT_LOGGER.info("processing queue split", component="queues",
+                            shard=shard_id, domain=domain_id,
+                            pending=pending)
+
+    def transfer_queue_states(self, shard_id: int) -> list:
+        """The admin/DescribeQueue surface: per-level (level, ack,
+        domains, excluded) for one shard."""
+        state = getattr(self, "_transfer_queues", {}).get(shard_id)
+        return state.states() if state is not None else []
 
     def process_transfer_once(self) -> int:
         """One pass over all owned shards; returns tasks processed."""

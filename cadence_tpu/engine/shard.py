@@ -217,3 +217,23 @@ class ShardContext:
         with self._lock:
             self._ensure_open()
             return self._info.transfer_ack_level
+
+    @property
+    def transfer_queue_states(self) -> list:
+        with self._lock:
+            self._ensure_open()
+            return [list(q) for q in self._info.transfer_queue_states]
+
+    def update_transfer_queue_states(self, states: list,
+                                     min_ack: int) -> None:
+        """Persist every processing queue's (level, ack, filter) plus the
+        GC floor = min over queues — the fenced write the next owner
+        resumes from (queue/interface.go ProcessingQueueState)."""
+        with self._lock:
+            self._ensure_open()
+            info = self._info
+            info.transfer_queue_states = [list(q) for q in states]
+            info.transfer_ack_level = max(info.transfer_ack_level, min_ack)
+            self._stores.shard.update(info, expected_range_id=info.range_id)
+            self._stores.shard_tasks.complete_transfer_below(
+                self.shard_id, info.transfer_ack_level)

@@ -54,6 +54,11 @@ class AckManager:
         with self._lock:
             self._outstanding.discard(task_id)
 
+    def in_flight(self) -> int:
+        """Registered-but-incomplete count (the merge-safety probe)."""
+        with self._lock:
+            return len(self._outstanding)
+
     def ack_level(self) -> int:
         """Highest id such that every registered id at or below it has
         completed; ids between registered ones are assumed absent (task

@@ -27,6 +27,11 @@ KEY_MAX_VERSION_HISTORY_ITEMS = "kernel.maxVersionHistoryItems"
 KEY_MAX_BRANCHES = "kernel.maxVersionHistoryBranches"
 # engine / queues
 KEY_QUEUE_BATCH_SIZE = "history.queueBatchSize"
+# multi-level processing queues (queue/split_policy.go): a domain whose
+# observed transfer backlog in one shard exceeds the threshold splits to
+# its own level (own ack, own reads) so it cannot starve siblings
+KEY_QUEUE_SPLIT_THRESHOLD = "history.queueSplitThreshold"
+KEY_QUEUE_MAX_LEVEL = "history.queueMaxLevel"
 # matching scale-out (matchingEngine.getAllPartitions / forwarder.go)
 KEY_MATCHING_NUM_PARTITIONS = "matching.numTasklistPartitions"
 KEY_RETENTION_DAYS_DEFAULT = "domain.defaultRetentionDays"
@@ -55,6 +60,8 @@ _DEFAULTS: Dict[str, Any] = {
     KEY_MAX_VERSION_HISTORY_ITEMS: 8,
     KEY_MAX_BRANCHES: 2,
     KEY_QUEUE_BATCH_SIZE: 100,
+    KEY_QUEUE_SPLIT_THRESHOLD: 500,
+    KEY_QUEUE_MAX_LEVEL: 2,
     KEY_MATCHING_NUM_PARTITIONS: 1,
     KEY_RETENTION_DAYS_DEFAULT: 1,
     KEY_FRONTEND_RPS: 0,          # 0 = unlimited
