@@ -49,7 +49,9 @@ import time
 import numpy as np
 
 # persistent compilation cache: repeated bench invocations (driver rounds,
-# operator reruns) skip recompiles; the cold/warm compile split is reported
+# operator reruns) skip recompiles. The env var alone is NOT enough on
+# hosts whose site bootstrap imports jax first — utils/compile_cache.py
+# applies the post-import config update in main()
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
 BASELINE_PER_CHIP = 16_700_000 / 8  # BASELINE.md derived kernel rate
@@ -323,7 +325,9 @@ def main() -> None:
     import jax
 
     from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+    from cadence_tpu.utils import compile_cache
 
+    compile_cache.enable()
     layout = DEFAULT_LAYOUT
     n_devices = jax.device_count()
 

@@ -45,7 +45,11 @@ def _ensure_jax_backend() -> None:
 def _build_cluster(wal: str):
     from .engine.durability import open_durable_stores, recover_stores
     from .engine.onebox import Onebox
+    from .utils import compile_cache
     from .utils.clock import RealTimeSource
+
+    # any device verify/rebuild this process runs reuses prior compiles
+    compile_cache.enable()
 
     if os.path.exists(wal):
         # commands verify explicitly (admin verify/scan); recovery itself

@@ -389,7 +389,11 @@ _SHARDED_CACHE: dict = {}
 
 def _sharded_fn(mesh, local: int, total_events: int,
                 layout: PayloadLayout, to_crc: bool = False):
-    from jax.experimental.shard_map import shard_map
+    # jax.shard_map is the stable home (jax.experimental.shard_map is
+    # deprecated since 0.8); keep the fallback for older pins
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older JAX
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from .state import init_state
@@ -410,6 +414,9 @@ def _sharded_fn(mesh, local: int, total_events: int,
                 # offset) are already shard-varying
                 if "shard" in getattr(jax.typeof(x), "vma", ()):
                     return x
+                if hasattr(jax.lax, "pcast"):
+                    # pvary's replacement (deprecated since 0.9)
+                    return jax.lax.pcast(x, ("shard",), to="varying")
                 return jax.lax.pvary(x, ("shard",))
             return jax.tree_util.tree_map(pv, tree)
 

@@ -1,0 +1,30 @@
+"""Persistent XLA compilation cache wiring.
+
+The first compile of the fused replay kernel costs tens of seconds per
+shape; without a persistent cache EVERY process (bench, CLI, service
+hosts, dryruns) pays it again. JAX supports a disk cache, but on hosts
+whose site bootstrap imports jax before user code (this environment's
+sitecustomize does), the JAX_COMPILATION_CACHE_DIR environment variable
+is read before it can be set — the config freezes at None and the cache
+silently never engages (observed: 123 stale entries, zero hits, 50s
+compiles in every process). The fix is the post-import config update
+this module applies; call enable() early in every entry point.
+"""
+from __future__ import annotations
+
+import os
+
+DEFAULT_DIR = "/tmp/jax_cache"
+
+
+def enable(path: str = "") -> str:
+    """Point JAX's persistent compilation cache at `path` (default: the
+    JAX_COMPILATION_CACHE_DIR env var, then /tmp/jax_cache). Idempotent;
+    returns the directory in use."""
+    import jax
+
+    path = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    return path

@@ -95,3 +95,20 @@ class TestGenerator:
 
         with pytest.raises(ValueError):
             generate_and_replay_sharded(11, 0, 65, E, mesh)
+
+
+def test_persistent_compile_cache_config_applied(tmp_path):
+    """enable() must set the post-import jax config — the env var alone
+    is frozen unread on hosts whose site bootstrap imports jax first
+    (VERDICT r4 #7: every process paid the ~50s compile)."""
+    import jax
+
+    from cadence_tpu.utils import compile_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        used = compile_cache.enable(str(tmp_path / "cache"))
+        assert jax.config.jax_compilation_cache_dir == used
+        assert (tmp_path / "cache").is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
