@@ -477,12 +477,61 @@ def gen_ndc(rng: random.Random, w: HistoryWriter, target_events: int = 100) -> N
         _close(w, rng, cyc)
 
 
+# ---------------------------------------------------------------------------
+# Suite: overflow (adversarial — a controlled fraction of workflows exceed
+# the device pending-activity table, forcing the oracle fallback)
+# ---------------------------------------------------------------------------
+
+#: fraction of overflow-suite workflows engineered to exceed the device
+#: tables (SURVEY §7 hard part 3: the fallback must be MEASURED under
+#: pressure, not always zero by construction)
+OVERFLOW_FRACTION = 0.025
+
+
+def gen_overflow(rng: random.Random, w: HistoryWriter,
+                 target_events: int = 100,
+                 capacity_hint: int = 16) -> None:
+    """Mostly gen_basic, but OVERFLOW_FRACTION of workflows pile up
+    `capacity_hint + 8` concurrently-pending activities in one decision —
+    past the device table, valid for the oracle (which has no capacity),
+    so the device flags TABLE_OVERFLOW and the engine falls back."""
+    if rng.random() >= OVERFLOW_FRACTION:
+        gen_basic(rng, w, target_events)
+        return
+    _start(w, rng)
+    cyc = _run_decision(w, 2)
+    completed = _begin_decision_completed_batch(w, cyc)
+    acts = [w.add(
+        EventType.ActivityTaskScheduled,
+        activity_id=f"flood-{i}", task_list="tl-default",
+        schedule_to_start_timeout_seconds=60,
+        schedule_to_close_timeout_seconds=120,
+        start_to_close_timeout_seconds=60, heartbeat_timeout_seconds=0,
+    ) for i in range(capacity_hint + 8)]
+    w.end_batch()
+    # drain them so the workflow still closes cleanly on the oracle
+    sched_id = None
+    for act in acts:
+        started = w.single(EventType.ActivityTaskStarted,
+                           scheduled_event_id=act.id,
+                           request_id=f"actpoll-{act.id}")
+        w.begin_batch()
+        w.add(EventType.ActivityTaskCompleted, scheduled_event_id=act.id,
+              started_event_id=started.id)
+        if act is acts[-1]:
+            sched_id = _schedule_decision(w, in_batch=True)
+        w.end_batch()
+    cyc = _run_decision(w, sched_id)
+    _close(w, rng, cyc)
+
+
 _GENERATORS = {
     "basic": gen_basic,
     "echo_signal": gen_echo_signal,
     "timer_retry": gen_timer_retry,
     "concurrent_child": gen_concurrent_child,
     "ndc": gen_ndc,
+    "overflow": gen_overflow,
 }
 
 

@@ -77,3 +77,63 @@ def test_ragged_lengths():
     assert (errors == 0).all()
     expected = oracle_rows(histories)
     assert (kernel == expected).all()
+
+
+class TestOverflowFallback:
+    """The adversarial overflow suite (SURVEY §7 hard part 3): a planted
+    fraction of workflows exceed the device pending tables; the device
+    must FLAG exactly those (TABLE_OVERFLOW), replay the rest correctly,
+    and the oracle leg must agree on every flagged workflow."""
+
+    def test_device_flags_planted_overflows_and_oracle_covers(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from cadence_tpu.core.checksum import (
+            DEFAULT_LAYOUT,
+            STICKY_ROW_INDEX,
+            crc32_of_row,
+            payload_row,
+        )
+        from cadence_tpu.gen.corpus import generate_corpus
+        from cadence_tpu.ops.encode import encode_corpus
+        from cadence_tpu.ops.wirec import pack_wirec
+        from cadence_tpu.ops.replay import replay_wirec_to_crc
+        from cadence_tpu.oracle.state_builder import StateBuilder
+
+        histories = generate_corpus("overflow", num_workflows=256, seed=3,
+                                    target_events=100)
+        ev = encode_corpus(histories)
+        c = pack_wirec(ev)
+        crc, errors = replay_wirec_to_crc(
+            jnp.asarray(c.slab), jnp.asarray(c.bases),
+            jnp.asarray(c.n_events), c.profile, DEFAULT_LAYOUT)
+        crc, errors = (np.asarray(crc).astype(np.uint32),
+                       np.asarray(errors))
+        flagged = set(np.nonzero(errors != 0)[0].tolist())
+        assert flagged, "no overflow planted — the suite is vacuous"
+        assert len(flagged) < 256 // 4, "overflow fraction far too high"
+        for i in range(256):
+            ms = StateBuilder().replay_history(histories[i])
+            row = payload_row(ms, DEFAULT_LAYOUT)
+            row[STICKY_ROW_INDEX] = 0
+            expect = np.uint32(crc32_of_row(row))
+            if i in flagged:
+                # flagged: the ORACLE leg is authoritative (and must
+                # replay the over-capacity history fine — it has none)
+                assert ms.execution_info.close_status != 0
+            else:
+                assert crc[i] == expect, f"unflagged workflow {i} diverged"
+        # the planted shape is what got flagged: >capacity pending
+        # activities at peak
+        from cadence_tpu.core.enums import EventType
+        for i in list(flagged)[:4]:
+            pend = peak = 0
+            for b in histories[i]:
+                for e in b.events:
+                    if e.event_type == EventType.ActivityTaskScheduled:
+                        pend += 1
+                        peak = max(peak, pend)
+                    elif e.event_type == EventType.ActivityTaskCompleted:
+                        pend -= 1
+            assert peak > DEFAULT_LAYOUT.max_activities
