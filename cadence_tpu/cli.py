@@ -163,8 +163,29 @@ def main(argv=None) -> int:
     cs.add_argument("--value", required=True)
     adm.add_parser("schema-version")
     adm.add_parser("schema-migrate")
+    # replication DLQ (tools/cli dlq read/purge/merge verbs)
+    adm.add_parser("dlq-read")
+    adm.add_parser("dlq-purge")
+    adm.add_parser("dlq-merge")
+    fo = adm.add_parser("failover")
+    fo.add_argument("--domain", required=True)
+    fo.add_argument("--to", required=True, help="target active cluster")
+
+    # WAL tools (adminDBScan/adminDBClean analogs over the one backend)
+    wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
+    wal_grp.add_parser("scan")
+    wal_grp.add_parser("clean")
+
+    # continuous canary (canary/cron.go)
+    can = sub.add_parser("canary").add_subparsers(dest="cmd", required=True)
+    crun = can.add_parser("run")
+    crun.add_argument("--domain", default="canary")
+    crun.add_argument("--cycles", type=int, default=10)
+    crun.add_argument("--interval", type=float, default=0.0)
 
     args = parser.parse_args(argv)
+    if args.group == "wal":
+        return _wal_tool(args)
     # schema tools run BEFORE cluster recovery (the cassandra/sql-tool
     # split: schema commands must work on logs recovery would refuse)
     if args.group == "admin" and args.cmd in ("schema-version",
@@ -317,6 +338,131 @@ def main(argv=None) -> int:
             from .engine.durability import config_record
             box.stores.wal.append(config_record(args.key, value))
             _emit({args.key: value})
+        elif args.cmd == "dlq-read":
+            from .engine.replication import REPLICATION_DLQ
+            entries = box.stores.queue.read(REPLICATION_DLQ, 0, 10_000)
+            _emit([{"index": i, "workflow_id": e.task.workflow_id,
+                    "run_id": e.task.run_id,
+                    "first_event_id": e.task.first_event_id,
+                    "next_event_id": e.task.next_event_id,
+                    "error": e.error}
+                   for i, e in entries])
+        elif args.cmd == "dlq-purge":
+            from .engine.replication import REPLICATION_DLQ
+            _emit({"purged": box.stores.queue.purge(REPLICATION_DLQ)})
+        elif args.cmd == "dlq-merge":
+            # re-apply quarantined tasks; only still-failing ones remain
+            # (dlq_handler.go merge semantics)
+            from .engine.replication import (
+                REPLICATION_DLQ,
+                HistoryReplicator,
+                ReplayError,
+                RetryReplicationError,
+            )
+            replicator = HistoryReplicator(box.stores,
+                                           rebuilder=box.rebuilder,
+                                           notifier=box.notifier)
+            entries = [e for _, e in box.stores.queue.read(
+                REPLICATION_DLQ, 0, 10_000)]
+            applied, still_failed = 0, []
+            for entry in entries:
+                try:
+                    replicator.apply(entry.task)
+                    applied += 1
+                except (RetryReplicationError, ReplayError) as exc:
+                    still_failed.append((entry, str(exc)))
+            box.stores.queue.purge(REPLICATION_DLQ)
+            for entry, _err in still_failed:
+                box.stores.queue.enqueue(REPLICATION_DLQ, entry)
+            _emit({"applied": applied, "still_failed": len(still_failed)})
+        elif args.cmd == "failover":
+            # flip the domain active to --to on THIS cluster's metadata
+            # and regenerate the promoted side's tasks (the CLI arm of
+            # adminFailoverCommands; the managed coordinator is
+            # engine/failovermanager.py over a cluster group)
+            info = box.frontend.update_domain(args.domain,
+                                              active_cluster=args.to)
+            from .engine.task_refresher import sweep_refresh
+            refreshed = sweep_refresh(box.stores, box.route, info.domain_id)
+            _emit({"domain": args.domain, "active_cluster": args.to,
+                   "failover_version": info.failover_version,
+                   "tasks_refreshed": refreshed})
+
+    elif args.group == "canary":
+        from .engine.canary import Canary
+        try:
+            box.frontend.register_domain(args.domain)
+        except Exception:
+            pass  # already registered
+        canary = Canary(box.frontend, args.domain, pump=box.pump_once)
+        report = canary.run(args.cycles, interval_s=args.interval)
+        _emit(report.summary())
+        return 0 if report.ok else 1
+    return 0
+
+
+def _wal_tool(args) -> int:
+    """WAL scan/clean (adminDBScanCommand/adminDBCleanCommand over the
+    one WAL backend): scan reports record-type counts, schema version,
+    unparseable lines, and tombstoned runs; clean rewrites the log
+    dropping corrupt lines and records superseded by delete tombstones
+    (atomic replace, like the schema migrator)."""
+    import json as _json
+
+    from .engine.durability import WAL_VERSION
+
+    if not os.path.exists(args.wal):
+        _emit({"error": f"no WAL at {args.wal}"})
+        return 1
+    records, bad = [], 0
+    with open(args.wal, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(_json.loads(line))
+            except Exception:
+                bad += 1
+    by_type: dict = {}
+    version = 1
+    tombstoned = set()
+    for rec in records:
+        by_type[rec.get("t", "?")] = by_type.get(rec.get("t", "?"), 0) + 1
+        if rec.get("t") == "ver":
+            version = rec["v"]
+        elif rec.get("t") == "delw":
+            tombstoned.add((rec["d"], rec["w"], rec["r"]))
+
+    if args.cmd == "scan":
+        _emit({"wal": args.wal, "records": len(records),
+               "bad_lines": bad, "schema_version": version,
+               "binary_version": WAL_VERSION,
+               "by_type": by_type, "tombstoned_runs": len(tombstoned),
+               "bytes": os.path.getsize(args.wal)})
+        return 0 if bad == 0 else 1
+
+    # clean: drop corrupt lines + every record of a tombstoned run (and
+    # the tombstone itself — replay without both is equivalent)
+    def run_key(rec):
+        if rec.get("t") in ("h", "f", "cb", "cur", "delw"):
+            return (rec.get("d"), rec.get("w"), rec.get("r"))
+        return None
+
+    kept = [rec for rec in records
+            if rec.get("t") != "ver" and run_key(rec) not in tombstoned]
+    tmp = args.wal + ".clean"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(_json.dumps({"t": "ver", "v": version},
+                             separators=(",", ":")) + "\n")
+        for rec in kept:
+            fh.write(_json.dumps(rec, separators=(",", ":")) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, args.wal)
+    _emit({"cleaned": args.wal, "dropped_bad_lines": bad,
+           "dropped_records": len(records) - len(kept),
+           "kept": len(kept) + 1})
     return 0
 
 

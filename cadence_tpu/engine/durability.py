@@ -282,6 +282,11 @@ def queue_ack_record(queue: str, consumer: str, index: int) -> dict:
     return {"t": "qa", "q": queue, "c": consumer, "i": index}
 
 
+def queue_purge_record(queue: str) -> dict:
+    """DLQ purge tombstone: recovery replays the purge in order."""
+    return {"t": "qp", "q": queue}
+
+
 def _repl_task_dict(task) -> dict:
     return {"d": task.domain_id, "w": task.workflow_id, "r": task.run_id,
             "f": task.first_event_id, "n": task.next_event_id,
@@ -413,6 +418,8 @@ def recover_stores(path: str, verify_on_device: bool = True,
                                  close_status=rec["cs"]))
         elif t == "qa":
             stores.queue.set_ack(rec["q"], rec["c"], rec["i"])
+        elif t == "qp":
+            stores.queue.purge(rec["q"])
         elif t == "q":
             if rec["k"] == "task":
                 stores.queue.enqueue(rec["q"], _repl_task_from(rec["p"]))

@@ -429,10 +429,11 @@ class Frontend:
         if run_id is None:
             run_id = self.stores.execution.get_current_run_id(
                 domain_id, workflow_id)
-        q = engine.queries.get((domain_id, workflow_id, run_id), query_id)
-        if q is None:
-            raise KeyError(f"unknown query {query_id}")
-        return q.state, q.result, q.failure
+        # engine-side unpack: the registry's PendingQuery carries a
+        # threading.Event, so the OBJECT must never cross the wire when
+        # the owner is a remote host — only the plain result tuple does
+        return engine.query_result_tuple(domain_id, workflow_id, run_id,
+                                         query_id)
 
     def respond_query_task_completed(self, execution: tuple, query_id: str,
                                      result: bytes) -> None:

@@ -1505,6 +1505,16 @@ class HistoryEngine:
         ms, _ = self._load(domain_id, workflow_id, run_id)
         return ms
 
+    def query_result_tuple(self, domain_id: str, workflow_id: str,
+                           run_id: str, query_id: str):
+        """(state, result, failure) of a registered query — the
+        wire-safe projection of the registry's PendingQuery (whose
+        threading.Event must never be pickled across hosts)."""
+        q = self.queries.get((domain_id, workflow_id, run_id), query_id)
+        if q is None:
+            raise KeyError(f"unknown query {query_id}")
+        return q.state, q.result, q.failure
+
     def get_history(self, domain_id: str, workflow_id: str,
                     run_id: Optional[str] = None) -> List[HistoryEvent]:
         if run_id is None:

