@@ -170,6 +170,12 @@ def main(argv=None) -> int:
     fo = adm.add_parser("failover")
     fo.add_argument("--domain", required=True)
     fo.add_argument("--to", required=True, help="target active cluster")
+    pr = adm.add_parser("profile")
+    pr.add_argument("--out", default="/tmp/cadence_tpu_profile",
+                    help="trace output directory (open with TensorBoard "
+                         "or Perfetto)")
+    pr.add_argument("--workflows", type=int, default=256)
+    pr.add_argument("--events", type=int, default=100)
 
     # WAL tools (adminDBScan/adminDBClean analogs over the one backend)
     wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
@@ -375,6 +381,44 @@ def main(argv=None) -> int:
             for entry, _err in still_failed:
                 box.stores.queue.enqueue(REPLICATION_DLQ, entry)
             _emit({"applied": applied, "still_failed": len(still_failed)})
+        elif args.cmd == "profile":
+            # pprof → JAX profiler (SURVEY §5): capture an XLA trace of a
+            # representative replay; the trace opens in TensorBoard's
+            # profile plugin or Perfetto
+            import time as _time
+
+            import jax
+            import numpy as np
+
+            from .gen.corpus import generate_corpus
+            from .ops.encode import LANE_EVENT_ID, encode_corpus
+            from .ops.replay import replay_wirec_to_crc
+            from .ops.wirec import pack_wirec
+
+            histories = generate_corpus("basic",
+                                        num_workflows=args.workflows,
+                                        seed=1, target_events=args.events)
+            events = encode_corpus(histories)
+            corpus = pack_wirec(events)
+            import jax.numpy as jnp
+            arrs = (jnp.asarray(corpus.slab), jnp.asarray(corpus.bases),
+                    jnp.asarray(corpus.n_events))
+            # warm (compile outside the trace: the trace should show the
+            # steady-state kernel, not the compiler)
+            np.asarray(replay_wirec_to_crc(*arrs, corpus.profile,
+                                           box.config.payload_layout())[0])
+            jax.profiler.start_trace(args.out)
+            t0 = _time.perf_counter()
+            crc, _err = replay_wirec_to_crc(*arrs, corpus.profile,
+                                            box.config.payload_layout())
+            np.asarray(crc)
+            wall = _time.perf_counter() - t0
+            jax.profiler.stop_trace()
+            real = int((events[:, :, LANE_EVENT_ID] > 0).sum())
+            _emit({"trace_dir": args.out, "workflows": args.workflows,
+                   "events": real, "wall_s": round(wall, 4),
+                   "events_per_sec": round(real / wall),
+                   "platform": jax.devices()[0].platform})
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of

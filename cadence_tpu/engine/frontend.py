@@ -294,15 +294,17 @@ class Frontend:
     # -- worker polls ------------------------------------------------------
 
     def poll_for_decision_task(self, domain: str, task_list: str,
-                               wait_seconds: float = 0
+                               wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[PollDecisionResponse]:
         """PollForDecisionTask (workflowHandler.go:580). With
         `wait_seconds` > 0 the poll LONG-POLLS: an empty task list parks
         the poll for sync-match instead of returning immediately (the
-        reference's long-poll transport over taskListManager's matcher)."""
+        reference's long-poll transport over taskListManager's matcher).
+        `identity` lands in DescribeTaskList's poller history."""
         domain_id = self.stores.domain.by_name(domain).domain_id
         task = self.matching.poll_and_wait_decision(domain_id, task_list,
-                                                    wait_seconds)
+                                                    wait_seconds,
+                                                    identity=identity)
         if task is None:
             return None
         try:
@@ -441,11 +443,12 @@ class Frontend:
         self.router(execution[1]).queries.complete(execution, query_id, result)
 
     def poll_for_activity_task(self, domain: str, task_list: str,
-                               wait_seconds: float = 0
+                               wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[PollActivityResponse]:
         domain_id = self.stores.domain.by_name(domain).domain_id
         task = self.matching.poll_and_wait_activity(domain_id, task_list,
-                                                    wait_seconds)
+                                                    wait_seconds,
+                                                    identity=identity)
         if task is None:
             return None
         try:

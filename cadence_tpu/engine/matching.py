@@ -309,6 +309,8 @@ class MatchingEngine:
         #: round-robin cursors per (domain, base, type) for add and poll
         self._add_rr: Dict[Tuple[str, str, int], int] = {}
         self._poll_rr: Dict[Tuple[str, str, int], int] = {}
+        #: (domain, base, type) → {identity: last_seen} (pollerHistory.go)
+        self._pollers: Dict[Tuple[str, str, int], Dict[str, float]] = {}
 
     def _manager(self, domain_id: str, name: str, task_type: int
                  ) -> _TaskListManager:
@@ -454,8 +456,26 @@ class MatchingEngine:
         return self._park(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY,
                           partition)
 
-    def poll_for_decision_task(self, domain_id: str, task_list: str
+    def _record_poller(self, domain_id: str, task_list: str,
+                       task_type: int, identity: str) -> None:
+        """Poller-identity history (matching/pollerHistory.go): recent
+        worker identities per task list, TTL'd by DescribeTaskList."""
+        if not identity:
+            return
+        import time as _time
+        with self._lock:
+            hist = self._pollers.setdefault((domain_id, task_list,
+                                             task_type), {})
+            hist[identity] = _time.time()
+            if len(hist) > 64:  # bounded, oldest out
+                oldest = min(hist, key=hist.get)
+                del hist[oldest]
+
+    def poll_for_decision_task(self, domain_id: str, task_list: str,
+                               identity: str = ""
                                ) -> Optional[MatchedTask]:
+        self._record_poller(domain_id, task_list, TASK_LIST_TYPE_DECISION,
+                            identity)
         q = self._manager(domain_id, task_list,
                           TASK_LIST_TYPE_DECISION).poll_query()
         if q is not None:
@@ -471,8 +491,11 @@ class MatchingEngine:
                            task_list=task_list, task_id=task.task_id,
                            source=src)
 
-    def poll_for_activity_task(self, domain_id: str, task_list: str
+    def poll_for_activity_task(self, domain_id: str, task_list: str,
+                               identity: str = ""
                                ) -> Optional[MatchedTask]:
+        self._record_poller(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY,
+                            identity)
         hit = self._poll_task(domain_id, task_list, TASK_LIST_TYPE_ACTIVITY)
         if hit is None:
             return None
@@ -483,12 +506,13 @@ class MatchingEngine:
                            source=src)
 
     def poll_and_wait_decision(self, domain_id: str, task_list: str,
-                               wait_seconds: float = 0
+                               wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[MatchedTask]:
         """Poll; on empty, park for sync-match up to `wait_seconds` (the
         long-poll composite — also the shape a long poll takes over the
         wire: the server blocks, no ParkedPoll object crosses processes)."""
-        task = self.poll_for_decision_task(domain_id, task_list)
+        task = self.poll_for_decision_task(domain_id, task_list,
+                                           identity=identity)
         if task is None and wait_seconds > 0:
             parked = self.park_for_decision_task(domain_id, task_list)
             parked.done.wait(wait_seconds)
@@ -498,9 +522,10 @@ class MatchingEngine:
         return task
 
     def poll_and_wait_activity(self, domain_id: str, task_list: str,
-                               wait_seconds: float = 0
+                               wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[MatchedTask]:
-        task = self.poll_for_activity_task(domain_id, task_list)
+        task = self.poll_for_activity_task(domain_id, task_list,
+                                           identity=identity)
         if task is None and wait_seconds > 0:
             parked = self.park_for_activity_task(domain_id, task_list)
             parked.done.wait(wait_seconds)
@@ -543,8 +568,17 @@ class MatchingEngine:
                 mgr = self._managers.get(key)
             if mgr is not None:
                 total += mgr.backlog()
+        import time as _time
+        with self._lock:
+            hist = self._pollers.get((domain_id, task_list, task_type), {})
+            cutoff = _time.time() - 300  # pollerHistory's 5-minute TTL
+            pollers = [{"identity": ident, "last_access_time": ts}
+                       for ident, ts in sorted(hist.items(),
+                                               key=lambda kv: -kv[1])
+                       if ts >= cutoff]
         return {"backlog": total,
-                "partitions": self._num_partitions(task_list)}
+                "partitions": self._num_partitions(task_list),
+                "pollers": pollers}
 
     def backlog(self) -> int:
         with self._lock:

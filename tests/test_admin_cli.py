@@ -236,3 +236,27 @@ class TestOpsVerbs:
                             "--cycles", "1")
         assert rc == 0, out
         assert out["green"] == 1 and out["ok"] is True
+
+
+class TestProfileVerb:
+    def _run(self, capsys, *argv):
+        rc = cli_main(list(argv))
+        out = capsys.readouterr().out
+        return rc, json.loads(out)
+
+    def test_profile_captures_trace(self, tmp_path, capsys):
+        """The pprof analog (SURVEY §5): `admin profile` captures a JAX
+        profiler trace of a representative replay to a directory."""
+        import os as _os
+        wal = str(tmp_path / "prof.wal")
+        out_dir = str(tmp_path / "trace")
+        rc, out = self._run(capsys, "--wal", wal, "admin", "profile",
+                            "--out", out_dir, "--workflows", "16",
+                            "--events", "40")
+        assert rc == 0
+        assert out["events_per_sec"] > 0
+        assert out["trace_dir"] == out_dir
+        found = []
+        for root, _dirs, files in _os.walk(out_dir):
+            found.extend(files)
+        assert found, "no trace files captured"

@@ -158,3 +158,102 @@ class TestVisibilityOutOfOrder:
         with pytest.raises(UnauthorizedError):
             box.frontend.signal_with_start_workflow_execution(
                 DOMAIN, "wf-x", "s", "t", TL)
+
+
+class TestOAuthAuthorizer:
+    """JWT claims-based authorizer (authorization/oauthAuthorizer.go):
+    HS256 tokens carry sub/permission/domain/admin/exp claims."""
+
+    def _attrs(self, permission, domain="", actor=""):
+        from cadence_tpu.engine.authorization import AuthAttributes
+        return AuthAttributes(api="x", permission=permission,
+                              domain=domain, actor=actor)
+
+    def test_valid_token_permission_mapping(self):
+        from cadence_tpu.engine.authorization import (
+            DECISION_ALLOW,
+            DECISION_DENY,
+            PERMISSION_ADMIN,
+            PERMISSION_READ,
+            PERMISSION_WRITE,
+            OAuthAuthorizer,
+            make_token,
+        )
+        auth = OAuthAuthorizer(b"secret")
+        tok = make_token(b"secret", "alice", PERMISSION_WRITE)
+        assert auth.authorize(self._attrs(PERMISSION_READ, actor=tok)) \
+            == DECISION_ALLOW
+        assert auth.authorize(self._attrs(PERMISSION_WRITE, actor=tok)) \
+            == DECISION_ALLOW
+        assert auth.authorize(self._attrs(PERMISSION_ADMIN, actor=tok)) \
+            == DECISION_DENY
+
+    def test_bad_signature_and_garbage_denied(self):
+        from cadence_tpu.engine.authorization import (
+            DECISION_DENY,
+            PERMISSION_READ,
+            OAuthAuthorizer,
+            make_token,
+        )
+        auth = OAuthAuthorizer(b"secret")
+        forged = make_token(b"WRONG", "mallory", "admin", admin=True)
+        assert auth.authorize(self._attrs(PERMISSION_READ, actor=forged)) \
+            == DECISION_DENY
+        assert auth.authorize(self._attrs(PERMISSION_READ,
+                                          actor="not-a-jwt")) \
+            == DECISION_DENY
+
+    def test_expiry_and_domain_binding(self):
+        from cadence_tpu.engine.authorization import (
+            DECISION_ALLOW,
+            DECISION_DENY,
+            PERMISSION_WRITE,
+            OAuthAuthorizer,
+            make_token,
+        )
+        now = [1000.0]
+        auth = OAuthAuthorizer(b"s", clock=lambda: now[0])
+        tok = make_token(b"s", "bob", PERMISSION_WRITE, domain="orders",
+                         ttl_seconds=60, now=now[0])
+        ok = self._attrs(PERMISSION_WRITE, domain="orders", actor=tok)
+        assert auth.authorize(ok) == DECISION_ALLOW
+        # bound to 'orders': another domain is denied
+        other = self._attrs(PERMISSION_WRITE, domain="billing", actor=tok)
+        assert auth.authorize(other) == DECISION_DENY
+        now[0] += 120  # past exp
+        assert auth.authorize(ok) == DECISION_DENY
+
+    def test_admin_claim_overrides(self):
+        from cadence_tpu.engine.authorization import (
+            DECISION_ALLOW,
+            PERMISSION_ADMIN,
+            OAuthAuthorizer,
+            make_token,
+        )
+        auth = OAuthAuthorizer(b"s")
+        tok = make_token(b"s", "root", admin=True)
+        assert auth.authorize(self._attrs(PERMISSION_ADMIN, actor=tok)) \
+            == DECISION_ALLOW
+
+    def test_frontend_gated_by_oauth(self):
+        """Wired into a live frontend: a read token cannot write."""
+        from cadence_tpu.engine.authorization import (
+            PERMISSION_READ,
+            PERMISSION_WRITE,
+            OAuthAuthorizer,
+            UnauthorizedError,
+            make_token,
+        )
+        from cadence_tpu.engine.onebox import Onebox
+
+        box = Onebox(num_hosts=1, num_shards=2)
+        box.frontend.authorizer = OAuthAuthorizer(b"cluster-secret")
+        writer = make_token(b"cluster-secret", "w", admin=True)
+        reader = make_token(b"cluster-secret", "r", PERMISSION_READ)
+        box.frontend.actor = writer
+        box.frontend.register_domain("oauth-dom")
+        box.frontend.start_workflow_execution("oauth-dom", "wf", "t", "tl")
+        box.frontend.actor = reader
+        import pytest as _pytest
+        with _pytest.raises(UnauthorizedError):
+            box.frontend.signal_workflow_execution("oauth-dom", "wf", "s")

@@ -238,3 +238,30 @@ class TestTwoPhaseAck:
         eng.complete_task(a, TASK_LIST_TYPE_DECISION)
         eng.complete_task(b, TASK_LIST_TYPE_DECISION)
         assert stores.task.get_tasks("d", TL, TASK_LIST_TYPE_DECISION, 0) == []
+
+
+class TestPollerHistory:
+    """Poller-identity history (matching/pollerHistory.go): recent worker
+    identities surface in DescribeTaskList with last-access times."""
+
+    def test_identities_recorded_and_surfaced(self):
+        from cadence_tpu.engine.onebox import Onebox
+
+        box = Onebox(num_hosts=1, num_shards=2)
+        box.frontend.register_domain("ph-dom")
+        domain_id = box.frontend.describe_domain("ph-dom").domain_id
+        for worker in ("worker-a", "worker-b"):
+            box.frontend.poll_for_decision_task("ph-dom", "ph-tl",
+                                                identity=worker)
+        box.frontend.poll_for_activity_task("ph-dom", "ph-tl",
+                                            identity="worker-act")
+        desc = box.matching.describe_task_list(domain_id, "ph-tl", 0)
+        idents = [p["identity"] for p in desc["pollers"]]
+        assert set(idents) == {"worker-a", "worker-b"}
+        assert all(p["last_access_time"] > 0 for p in desc["pollers"])
+        desc_act = box.matching.describe_task_list(domain_id, "ph-tl", 1)
+        assert [p["identity"] for p in desc_act["pollers"]] == ["worker-act"]
+        # anonymous polls don't pollute the history
+        box.frontend.poll_for_decision_task("ph-dom", "ph-tl")
+        desc = box.matching.describe_task_list(domain_id, "ph-tl", 0)
+        assert len(desc["pollers"]) == 2
