@@ -116,8 +116,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cadence-tpu-store")
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--wal", default="")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 in containers; the HMAC "
+                        "connection preamble still gates every peer)")
     args = p.parse_args(argv)
-    serve(args.port, args.wal)
+    serve(args.port, args.wal, host=args.host)
     return 0
 
 
