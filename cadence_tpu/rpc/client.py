@@ -65,8 +65,9 @@ class RemoteStores:
         for sub in SUBSTORES:
             setattr(self, sub, _RemoteSubStore(self._pool, sub))
 
-    def heartbeat(self, host: str, port: int) -> None:
-        self._pool.call(("hb", host, port))
+    def heartbeat(self, name: str, port: int,
+                  address: str = "127.0.0.1") -> None:
+        self._pool.call(("hb", name, port, address))
 
     def peers(self, ttl: float):
         return self._pool.call(("peers", ttl))
@@ -149,11 +150,14 @@ class RemoteCluster:
         self._host_pools: dict = {}
 
     def live_host_pools(self):
-        """One _Pool per live peer host, preferring already-open pools."""
+        """One _Pool per live peer host, preferring already-open pools.
+        Peers dial the ADVERTISED host from the heartbeat table (old
+        2-tuple entries imply loopback)."""
         peers = self.stores.peers(self.peer_ttl)
         pools = []
-        for host, port in peers:
-            key = ("127.0.0.1", port)
+        for entry in peers:
+            key = ((entry[2], entry[1]) if len(entry) > 2
+                   else ("127.0.0.1", entry[1]))
             if key not in self._host_pools:
                 self._host_pools[key] = _Pool(key)
             pools.append(self._host_pools[key])

@@ -138,7 +138,8 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                  hb_interval: float = 0.15, ttl: float = 3.0,
                  pump_interval: float = 0.05,
                  cluster_name: str = "primary",
-                 peers: Optional[Dict[str, Tuple[str, int]]] = None) -> None:
+                 peers: Optional[Dict[str, Tuple[str, int]]] = None,
+                 advertise_host: str = "127.0.0.1") -> None:
         super().__init__(address, _Handler)
         from ..utils import compile_cache
         from ..utils.dynamicconfig import DynamicConfig
@@ -151,6 +152,9 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         compile_cache.enable()
         self.name = name
         self.port = address[1]
+        #: the address peers must DIAL to reach this host (loopback only
+        #: works single-machine; containers advertise their service name)
+        self.advertise_host = advertise_host
         self.stores = RemoteStores(store_address)
         self.num_shards = num_shards
         self.hb_interval = hb_interval
@@ -167,7 +171,7 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self._publisher_holder: Dict[str, object] = {"pub": None}
         #: name → (host, port) of every live peer (incl. self)
         self._peer_addresses: Dict[str, Tuple[str, int]] = {
-            name: ("127.0.0.1", address[1])}
+            name: (advertise_host, address[1])}
         self.ring = HashRing([name])
         self.controller = ShardController(name, num_shards, self.stores,
                                           self.ring, self.clock,
@@ -330,7 +334,8 @@ class ServiceHost(socketserver.ThreadingTCPServer):
 
     def tasklist_owner(self, task_list: str) -> Tuple[str, Tuple[str, int]]:
         owner = self.ring.lookup(f"tasklist-{task_list}")
-        return owner, self._peer_addresses.get(owner, ("127.0.0.1", self.port))
+        return owner, self._peer_addresses.get(
+            owner, (self.advertise_host, self.port))
 
     # -- membership --------------------------------------------------------
 
@@ -342,11 +347,17 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                 continue  # store server briefly unreachable: keep beating
 
     def refresh_membership(self) -> None:
-        self.stores.heartbeat(self.name, self.port)
+        self.stores.heartbeat(self.name, self.port, self.advertise_host)
         peers = self.stores.peers(self.ttl)
-        names = {h for h, _ in peers}
-        self._peer_addresses = {h: ("127.0.0.1", p) for h, p in peers}
-        self._peer_addresses.setdefault(self.name, ("127.0.0.1", self.port))
+        names = {entry[0] for entry in peers}
+        # peers carry their ADVERTISED host in the heartbeat table (old
+        # 2-tuple servers imply loopback)
+        self._peer_addresses = {
+            entry[0]: ((entry[2], entry[1]) if len(entry) > 2
+                       else ("127.0.0.1", entry[1]))
+            for entry in peers}
+        self._peer_addresses.setdefault(
+            self.name, (self.advertise_host, self.port))
         current = set(self.ring.members())
         if names and names != current:
             # ring changes fire the controller's acquire/release callback
@@ -483,6 +494,12 @@ def main(argv=None) -> int:
     p.add_argument("--cluster-name", default="primary")
     p.add_argument("--peer", action="append", default=[],
                    help="peer cluster as NAME=STOREHOST:PORT (repeatable)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 in containers)")
+    p.add_argument("--advertise-host", default="",
+                   help="address peers dial to reach this host (defaults "
+                        "to --host, or 127.0.0.1 when binding 0.0.0.0; "
+                        "containers pass their service name)")
     args = p.parse_args(argv)
     shost, sport = args.store.rsplit(":", 1)
     peers = {}
@@ -490,10 +507,13 @@ def main(argv=None) -> int:
         pname, paddr = spec.split("=", 1)
         ph, pp = paddr.rsplit(":", 1)
         peers[pname] = (ph, int(pp))
-    host = ServiceHost(args.name, ("127.0.0.1", args.port),
+    advertise = args.advertise_host or (
+        args.host if args.host != "0.0.0.0" else "127.0.0.1")
+    host = ServiceHost(args.name, (args.host, args.port),
                        (shost, int(sport)), args.num_shards,
                        hb_interval=args.hb_interval, ttl=args.ttl,
-                       cluster_name=args.cluster_name, peers=peers)
+                       cluster_name=args.cluster_name, peers=peers,
+                       advertise_host=advertise)
     host.start()
     threading.Event().wait()  # serve until killed
     return 0

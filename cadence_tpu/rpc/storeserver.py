@@ -7,7 +7,7 @@ host's memory. This process plays that role: it owns the authoritative
 `Stores` bundle (optionally durable via the WAL) and serves
 
   ("store", sub, method, args, kwargs)  → getattr(stores.<sub>, method)(...)
-  ("hb", host, host_port)               → membership heartbeat upsert
+  ("hb", name, port, advertised_host)   → membership heartbeat upsert
   ("peers", ttl_seconds)                → [(host, port)] with fresh beats
   ("ping",)                             → "pong"
 
@@ -41,14 +41,20 @@ class StoreServer(socketserver.ThreadingTCPServer):
         self._beats: Dict[Tuple[str, int], float] = {}
         self._beats_lock = threading.Lock()
 
-    def heartbeat(self, host: str, port: int) -> None:
+    def heartbeat(self, name: str, port: int,
+                  address: str = "127.0.0.1") -> None:
+        """`address` is the beater's ADVERTISED host — what peers and
+        remote clusters must dial (loopback only works single-machine;
+        containers advertise their service name)."""
         with self._beats_lock:
-            self._beats[(host, port)] = time.monotonic()
+            self._beats[(name, port)] = (time.monotonic(), address)
 
     def peers(self, ttl: float):
+        """[(name, port, address)] of live beaters."""
         now = time.monotonic()
         with self._beats_lock:
-            return sorted((h, p) for (h, p), t in self._beats.items()
+            return sorted((n, p, addr)
+                          for (n, p), (t, addr) in self._beats.items()
                           if now - t <= ttl)
 
 
@@ -73,7 +79,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     target = getattr(server.stores, sub)
                     result = getattr(target, method)(*args, **kwargs)
                 elif op == "hb":
-                    server.heartbeat(req[1], req[2])
+                    server.heartbeat(req[1], req[2],
+                                     req[3] if len(req) > 3 else "127.0.0.1")
                     result = None
                 elif op == "peers":
                     result = server.peers(req[1])

@@ -33,7 +33,16 @@ EOF
 }
 
 up() {
-  mkdir -p "$RUN_DIR"; : > "$PIDS"
+  mkdir -p "$RUN_DIR"
+  if [ -f "$PIDS" ]; then
+    while read -r pid name; do
+      if kill -0 "$pid" 2>/dev/null; then
+        echo "refusing: $name (pid $pid) still running — run down first" >&2
+        exit 1
+      fi
+    done < "$PIDS"
+  fi
+  : > "$PIDS"
   spawn store python -m cadence_tpu.rpc.storeserver --port 7240 \
       --wal "$RUN_DIR/primary.wal"
   wait_port 7240
@@ -54,7 +63,7 @@ up() {
     spawn "host-$i" python -m cadence_tpu.rpc.server \
         --name "host-$i" --port "724$((i+1))" \
         --store 127.0.0.1:7240 --num-shards 16 \
-        --cluster-name primary "${peer_args[@]}"
+        --cluster-name primary ${peer_args[@]+"${peer_args[@]}"}
   done
   wait_port 7241
   echo "cluster up: store 127.0.0.1:7240, frontends 7241/7242" \
