@@ -198,12 +198,12 @@ def main(argv=None) -> int:
                                               "schema-migrate"):
         from .engine.durability import (
             WAL_VERSION,
-            DurableLog,
             migrate_wal_file,
+            read_log,
             wal_version,
         )
         if args.cmd == "schema-version":
-            current = (wal_version(DurableLog.read_all(args.wal))
+            current = (wal_version(read_log(args.wal))
                        if os.path.exists(args.wal) else None)
             _emit({"wal": args.wal, "version": current,
                    "binary_version": WAL_VERSION})
@@ -453,21 +453,22 @@ def _wal_tool(args) -> int:
     (atomic replace, like the schema migrator)."""
     import json as _json
 
-    from .engine.durability import WAL_VERSION
+    from .engine.durability import WAL_VERSION, SqliteLog, is_sqlite_path
 
     if not os.path.exists(args.wal):
         _emit({"error": f"no WAL at {args.wal}"})
         return 1
     records, bad = [], 0
-    with open(args.wal, "r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(_json.loads(line))
-            except Exception:
-                bad += 1
+    if is_sqlite_path(args.wal):
+        raw_lines = SqliteLog.read_raw(args.wal)
+    else:
+        with open(args.wal, "r", encoding="utf-8") as fh:
+            raw_lines = [l.strip() for l in fh if l.strip()]
+    for line in raw_lines:
+        try:
+            records.append(_json.loads(line))
+        except Exception:
+            bad += 1
     by_type: dict = {}
     version = 1
     tombstoned = set()
@@ -495,15 +496,19 @@ def _wal_tool(args) -> int:
 
     kept = [rec for rec in records
             if rec.get("t") != "ver" and run_key(rec) not in tombstoned]
-    tmp = args.wal + ".clean"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        fh.write(_json.dumps({"t": "ver", "v": version},
-                             separators=(",", ":")) + "\n")
-        for rec in kept:
-            fh.write(_json.dumps(rec, separators=(",", ":")) + "\n")
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, args.wal)
+    if is_sqlite_path(args.wal):
+        SqliteLog.rewrite(args.wal,
+                          [{"t": "ver", "v": version}] + kept)
+    else:
+        tmp = args.wal + ".clean"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(_json.dumps({"t": "ver", "v": version},
+                                 separators=(",", ":")) + "\n")
+            for rec in kept:
+                fh.write(_json.dumps(rec, separators=(",", ":")) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, args.wal)
     _emit({"cleaned": args.wal, "dropped_bad_lines": bad,
            "dropped_records": len(records) - len(kept),
            "kept": len(kept) + 1})

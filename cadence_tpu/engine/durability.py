@@ -57,7 +57,34 @@ class DurableLog:
         self.path = path
         self.fsync = fsync
         self._lock = threading.Lock()
+        self._heal_torn_tail(path)
         self._fh = open(path, "a", encoding="utf-8")
+
+    @staticmethod
+    def _heal_torn_tail(path: str) -> None:
+        """Truncate a torn FINAL record before appending: a kill
+        mid-append can leave a partial last line (with or without its
+        newline), and appending straight after it would weld the next
+        record onto garbage — converting a recoverable torn tail into
+        permanent MID-file corruption on the following recovery."""
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            return
+        with open(path, "rb") as fh:
+            data = fh.read()
+        keep = len(data)
+        if not data.endswith(b"\n"):
+            keep = data.rfind(b"\n") + 1  # drop the unterminated tail
+        else:
+            last_start = data.rfind(b"\n", 0, len(data) - 1) + 1
+            try:
+                json.loads(data[last_start:].decode("utf-8"))
+            except Exception:
+                keep = last_start  # newline-terminated but torn JSON
+        if keep != len(data):
+            with open(path, "r+b") as fh:
+                fh.truncate(keep)
+                fh.flush()
+                os.fsync(fh.fileno())
 
     def append(self, record: dict) -> None:
         line = json.dumps(record, separators=(",", ":"))
@@ -94,6 +121,107 @@ class DurableLog:
 
 class CorruptLogError(Exception):
     """Mid-file WAL corruption (not a torn tail)."""
+
+
+class SqliteLog:
+    """SQLite-backed write-ahead log: the second storage backend (the
+    reference's sql persistence plugin next to nosql,
+    common/persistence/sql/). Same append/read_all/close contract as the
+    JSONL DurableLog — selected by path extension (.db/.sqlite/.sqlite3)
+    in open_log — with single-file transactional durability: appends
+    commit atomically, so there is no torn-tail case at all, and a
+    corrupt row anywhere is a real error."""
+
+    def __init__(self, path: str, fsync: bool = False) -> None:
+        import sqlite3
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            f"PRAGMA synchronous={'FULL' if fsync else 'NORMAL'}")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS records ("
+            "id INTEGER PRIMARY KEY AUTOINCREMENT, body TEXT NOT NULL)")
+        self._conn.commit()
+
+    def append(self, record: dict) -> None:
+        body = json.dumps(record, separators=(",", ":"))
+        with self._lock:
+            self._conn.execute("INSERT INTO records(body) VALUES (?)",
+                               (body,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    @staticmethod
+    def read_raw(path: str) -> List[str]:
+        """Committed record bodies in order (the tolerant read the CLI's
+        wal scan shares — one copy of the SELECT, not two)."""
+        import sqlite3
+        conn = sqlite3.connect(path)
+        try:
+            return [body for (body,) in conn.execute(
+                "SELECT body FROM records ORDER BY id").fetchall()]
+        finally:
+            conn.close()
+
+    @staticmethod
+    def read_all(path: str) -> List[dict]:
+        records = []
+        for i, body in enumerate(SqliteLog.read_raw(path)):
+            try:
+                records.append(json.loads(body))
+            except json.JSONDecodeError:
+                # committed rows are never torn — any corruption is real
+                raise CorruptLogError(f"{path}: corrupt record at row {i}")
+        return records
+
+    @staticmethod
+    def rewrite(path: str, records: List[dict]) -> None:
+        """Atomic whole-log rewrite (migration/compaction): build a fresh
+        database beside the old one, then rename over it."""
+        import sqlite3
+        tmp = path + ".rewrite"
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        conn = sqlite3.connect(tmp)
+        try:
+            conn.execute(
+                "CREATE TABLE records (id INTEGER PRIMARY KEY "
+                "AUTOINCREMENT, body TEXT NOT NULL)")
+            conn.executemany(
+                "INSERT INTO records(body) VALUES (?)",
+                [(json.dumps(r, separators=(",", ":")),) for r in records])
+            conn.commit()
+        finally:
+            conn.close()
+        os.replace(tmp, path)
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                         os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)  # commit the rename itself (same contract
+            # as the JSONL migrate path)
+        finally:
+            os.close(dir_fd)
+
+
+def is_sqlite_path(path: str) -> bool:
+    return path.endswith((".db", ".sqlite", ".sqlite3"))
+
+
+def open_log(path: str, fsync: bool = False):
+    """The storage-plugin seam (persistence factory by config): backend
+    chosen by path extension — .db/.sqlite* → SqliteLog, else JSONL."""
+    return (SqliteLog(path, fsync=fsync) if is_sqlite_path(path)
+            else DurableLog(path, fsync=fsync))
+
+
+def read_log(path: str) -> List[dict]:
+    return (SqliteLog.read_all(path) if is_sqlite_path(path)
+            else DurableLog.read_all(path))
 
 
 # ---------------------------------------------------------------------------
@@ -174,8 +302,11 @@ def migrate_wal_file(path: str) -> Tuple[int, int]:
     """Rewrite the log at WAL_VERSION (the schema tool's update-schema):
     atomic replace, with the version header first. Returns
     (from_version, to_version)."""
-    records = DurableLog.read_all(path)
+    records = read_log(path)
     body, original = migrate_records(records)
+    if is_sqlite_path(path):
+        SqliteLog.rewrite(path, [version_record()] + body)
+        return original, WAL_VERSION
     tmp = path + ".migrate"
     with open(tmp, "w", encoding="utf-8") as fh:
         fh.write(json.dumps(version_record(), separators=(",", ":")) + "\n")
@@ -335,9 +466,9 @@ def open_durable_stores(path: str) -> Stores:
     """Fresh cluster bundle logging to `path` (creates/extends the log);
     new logs start with the schema-version header."""
     import os as _os
-    fresh = not _os.path.exists(path) or _os.path.getsize(path) == 0
+    fresh = not _os.path.exists(path) or not read_log(path)
     stores = Stores()
-    wal = DurableLog(path)
+    wal = open_log(path)
     if fresh:
         wal.append(version_record())
     stores.attach_wal(wal)
@@ -367,7 +498,7 @@ def recover_stores(path: str, verify_on_device: bool = True,
     referenced_runs = set()
     # schema gate + in-memory migration (the setup/update-schema contract):
     # older logs lift transparently; NEWER logs refuse
-    records, _original = migrate_records(DurableLog.read_all(path))
+    records, _original = migrate_records(read_log(path))
     for rec in records:
         t = rec["t"]
         if t == "d":
@@ -443,7 +574,7 @@ def recover_stores(path: str, verify_on_device: bool = True,
     # new writes continue the same log (records are idempotent to replay:
     # recovery takes the last pointer values and appends are per-branch
     # contiguous, so a recovered process re-logging is consistent)
-    wal = DurableLog(path)
+    wal = open_log(path)
     if _original < WAL_VERSION:
         # records appended from here on are CURRENT-format; stamp a
         # mid-file version header ("last ver record wins") so the next

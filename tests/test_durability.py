@@ -279,3 +279,44 @@ class TestOrphanQuarantine:
         # the real workflow is still there and closed
         closed = stores.visibility.list_closed(domain_id)
         assert "wf-live" in [r.workflow_id for r in closed]
+
+
+class TestTornTailHealing:
+    """A kill mid-append leaves a partial final line; reopening the log
+    must TRUNCATE it before appending, or the next record welds onto
+    garbage and a recoverable torn tail becomes permanent MID-file
+    corruption (code-review r5 finding)."""
+
+    def test_append_after_torn_tail_stays_recoverable(self, tmp_path):
+        import json as _json
+
+        from cadence_tpu.engine.durability import DurableLog
+
+        wal = str(tmp_path / "torn.jsonl")
+        log = DurableLog(wal)
+        log.append({"t": "ver", "v": 2})
+        log.append({"t": "cfg", "k": "a", "v": 1, "dom": None})
+        log.close()
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write('{"t": "cfg", "k": "torn')  # no newline: torn tail
+        # reopen + append (what a recovered process does)
+        log = DurableLog(wal)
+        log.append({"t": "cfg", "k": "b", "v": 2, "dom": None})
+        log.close()
+        records = DurableLog.read_all(wal)  # must NOT raise CorruptLog
+        assert [r.get("k") for r in records] == [None, "a", "b"]
+
+    def test_newline_terminated_torn_json_also_healed(self, tmp_path):
+        from cadence_tpu.engine.durability import DurableLog
+
+        wal = str(tmp_path / "torn2.jsonl")
+        log = DurableLog(wal)
+        log.append({"t": "ver", "v": 2})
+        log.close()
+        with open(wal, "a", encoding="utf-8") as fh:
+            fh.write('{"t": "cfg", "k"\n')  # torn JSON, newline present
+        log = DurableLog(wal)
+        log.append({"t": "cfg", "k": "c", "v": 3, "dom": None})
+        log.close()
+        records = DurableLog.read_all(wal)
+        assert [r["t"] for r in records] == ["ver", "cfg"]

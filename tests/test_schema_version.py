@@ -126,3 +126,73 @@ class TestSchemaVersion:
         stores2, _ = recover_stores(wal, verify_on_device=False,
                                     rebuild_on_device=False)
         assert stores2.domain.by_name(DOMAIN).retention_days == 3
+
+
+class TestSqliteBackend:
+    """The second storage backend (the sql persistence plugin next to
+    nosql): a SQLite WAL selected by path extension, same record
+    contract, crash-recovery and migration included."""
+
+    def _run_workflow(self, wal):
+        from cadence_tpu.engine.durability import open_durable_stores
+        box = Onebox(num_hosts=1, num_shards=4,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "wf-sq", "echo", TL)
+        TaskPoller(box, DOMAIN, TL, {"wf-sq": EchoDecider(TL)}).drain()
+        box.stores.wal.close()
+
+    def test_workflow_survives_recovery(self, tmp_path):
+        wal = str(tmp_path / "cluster.db")
+        self._run_workflow(wal)
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        domain_id = stores.domain.by_name(DOMAIN).domain_id
+        run = stores.execution.get_current_run_id(domain_id, "wf-sq")
+        ms = stores.execution.get_workflow(domain_id, "wf-sq", run)
+        assert ms.execution_info.close_status == CloseStatus.Completed
+        stores.wal.close()
+        # records round-trip identically through both backends
+        from cadence_tpu.engine.durability import read_log
+        assert read_log(wal)[0] == {"t": "ver", "v": WAL_VERSION}
+
+    def test_migration_over_sqlite(self, tmp_path):
+        """migrate_wal_file rewrites a v1 SQLite log atomically."""
+        from cadence_tpu.engine.durability import SqliteLog, read_log
+        wal = str(tmp_path / "old.db")
+        SqliteLog.rewrite(wal, [
+            {"t": "d", "id": "d-1", "name": DOMAIN, "ret": 3, "act": True,
+             "ac": "primary", "cl": ["primary"], "fv": 0, "nv": 0}])
+        assert wal_version(read_log(wal)) == 1
+        before, after = migrate_wal_file(wal)
+        assert (before, after) == (1, WAL_VERSION)
+        assert wal_version(read_log(wal)) == WAL_VERSION
+        stores, _ = recover_stores(wal, verify_on_device=False,
+                                   rebuild_on_device=False)
+        assert stores.domain.by_name(DOMAIN).retention_days == 3
+
+    def test_cli_drives_sqlite_wal(self, tmp_path, capsys):
+        """The CLI's --wal picks the backend by extension; scan/clean
+        work over SQLite rows."""
+        import json as _json
+
+        from cadence_tpu.cli import main as cli_main
+        wal = str(tmp_path / "cli.db")
+
+        def run(*argv):
+            rc = cli_main(list(argv))
+            return rc, _json.loads(capsys.readouterr().out)
+
+        rc, out = run("--wal", wal, "domain", "register", "--name", "sq-d")
+        assert rc == 0
+        rc, out = run("--wal", wal, "workflow", "start", "--domain", "sq-d",
+                      "--workflow-id", "w", "--type", "t",
+                      "--task-list", TL)
+        assert rc == 0
+        rc, out = run("--wal", wal, "wal", "scan")
+        assert rc == 0 and out["bad_lines"] == 0 and out["records"] > 3
+        rc, out = run("--wal", wal, "wal", "clean")
+        assert rc == 0
+        rc, out = run("--wal", wal, "workflow", "describe",
+                      "--domain", "sq-d", "--workflow-id", "w")
+        assert rc == 0
