@@ -411,6 +411,18 @@ def main() -> None:
     fallback = _fallback_suite(suite_workflows, layout)
     feeder = _feeder_rate(layout)
 
+    # observability snapshot: the profiler's pack/h2d/kernel/readback leg
+    # decomposition (fed by the instrumented feeder path) plus every tpu.*
+    # metric scope — so BENCH_r*.json trajectories diff leg-by-leg
+    from cadence_tpu.utils import metrics as cm
+    from cadence_tpu.utils.profiler import ReplayProfiler
+    observability = {
+        "profiler": ReplayProfiler().summary(),
+        "metrics": {scope: values
+                    for scope, values in cm.DEFAULT_REGISTRY.snapshot().items()
+                    if scope.startswith("tpu.")},
+    }
+
     rate_per_chip = north["rate"] / n_devices
     north["rate"] = round(north["rate"])
     print(json.dumps({
@@ -425,6 +437,7 @@ def main() -> None:
             "suites": suites,
             "fallback_under_pressure": fallback,
             "feeder": feeder,
+            "observability": observability,
         },
     }))
 

@@ -415,10 +415,22 @@ def main(argv=None) -> int:
             wall = _time.perf_counter() - t0
             jax.profiler.stop_trace()
             real = int((events[:, :, LANE_EVENT_ID] > 0).sum())
+            # leg breakdown (pack/h2d/kernel/readback): run the same
+            # corpus through the instrumented host path so the XLA trace
+            # ships with the histogram decomposition of its launch; the
+            # first pass pays the compile, then the registry is cleared so
+            # `legs` reports only the warm steady-state launch
+            from .ops.replay import replay_corpus
+            from .utils.metrics import DEFAULT_REGISTRY
+            from .utils.profiler import ReplayProfiler
+            replay_corpus(histories, box.config.payload_layout())  # warm
+            DEFAULT_REGISTRY.reset()
+            replay_corpus(histories, box.config.payload_layout())
             _emit({"trace_dir": args.out, "workflows": args.workflows,
                    "events": real, "wall_s": round(wall, 4),
                    "events_per_sec": round(real / wall),
-                   "platform": jax.devices()[0].platform})
+                   "platform": jax.devices()[0].platform,
+                   "legs": ReplayProfiler().summary()})
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of

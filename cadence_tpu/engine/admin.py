@@ -101,6 +101,14 @@ class AdminHandler:
             "metrics": self.box.metrics.snapshot(),
         }
 
+    def metrics(self) -> Dict[str, Any]:
+        """The scrape surface as an admin call: structured snapshot (with
+        percentiles) plus the prometheus text rendering — what the
+        ServiceHost `admin_metrics` wire op and GET /metrics serve."""
+        self._authorize("metrics")
+        return {"snapshot": self.box.metrics.snapshot(),
+                "prometheus": self.box.metrics.to_prometheus()}
+
     # -- queue introspection (DescribeQueue, handler.go:851) ---------------
 
     def describe_queue(self, shard_id: int) -> Dict[str, Any]:

@@ -14,6 +14,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.events import HistoryEvent, RetryPolicy
 from ..oracle.mutable_state import MutableState
+from ..utils import metrics as m
+from ..utils import tracing
 from .history_engine import Decision, HistoryEngine, TaskToken
 from .matching import (
     TASK_LIST_TYPE_ACTIVITY,
@@ -55,7 +57,6 @@ class Frontend:
                  router: Callable[[str], HistoryEngine],
                  config=None, metrics=None, time_source=None,
                  cluster_name: str = "primary") -> None:
-        from ..utils import metrics as m
         from ..utils.clock import RealTimeSource
         from ..utils.dynamicconfig import (
             KEY_FRONTEND_BURST,
@@ -94,7 +95,6 @@ class Frontend:
         )
 
     def _admit(self, domain: str, scope: str) -> None:
-        from ..utils import metrics as m
         from ..utils.quotas import ServiceBusyError
         if not self.rate_limiter.allow(domain):
             self.metrics.inc(scope, m.M_RATE_LIMITED)
@@ -176,6 +176,7 @@ class Frontend:
 
     # -- workflow lifecycle ------------------------------------------------
 
+    @tracing.traced(m.SCOPE_FRONTEND_START)
     def start_workflow_execution(self, domain: str, workflow_id: str,
                                  workflow_type: str, task_list: str,
                                  execution_timeout: int = 3600,
@@ -185,7 +186,6 @@ class Frontend:
                                  retry_policy: Optional[RetryPolicy] = None,
                                  input_payload: bytes = b"",
                                  ) -> str:
-        from ..utils import metrics as m
         from .authorization import PERMISSION_WRITE
         from .limits import check_blob_size
         self._authorize("StartWorkflowExecution", PERMISSION_WRITE, domain)
@@ -211,13 +211,13 @@ class Frontend:
             input_payload=input_payload,
         )
 
+    @tracing.traced(m.SCOPE_FRONTEND_SIGNAL)
     def signal_workflow_execution(self, domain: str, workflow_id: str,
                                   signal_name: str,
                                   run_id: Optional[str] = None,
                                   request_id: Optional[str] = None) -> None:
         """request_id (SignalWorkflowExecutionRequest.RequestId) dedups
         client retries: a signal already applied under the same id no-ops."""
-        from ..utils import metrics as m
         from .authorization import PERMISSION_WRITE
         self._authorize("SignalWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
@@ -237,7 +237,6 @@ class Frontend:
         signal the running execution, or atomically start one whose first
         transaction carries the signal. Returns the run ID signaled or
         started."""
-        from ..utils import metrics as m
         from .authorization import PERMISSION_WRITE
         self._authorize("SignalWithStartWorkflowExecution", PERMISSION_WRITE,
                         domain)
@@ -293,6 +292,7 @@ class Frontend:
 
     # -- worker polls ------------------------------------------------------
 
+    @tracing.traced(m.SCOPE_FRONTEND_POLL_DECISION)
     def poll_for_decision_task(self, domain: str, task_list: str,
                                wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[PollDecisionResponse]:

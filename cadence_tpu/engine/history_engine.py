@@ -41,6 +41,8 @@ from ..core.enums import (
 from ..core.events import HistoryBatch, HistoryEvent, RetryPolicy
 from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
 from ..oracle.state_builder import StateBuilder
+from ..utils import metrics as m
+from ..utils import tracing
 from ..utils.clock import TimeSource
 from .persistence import DomainInfo, EntityNotExistsError, Stores
 from .shard import ShardContext
@@ -288,7 +290,6 @@ class HistoryEngine:
                 flushed_started[attrs.get("scheduled_event_id")] = real.id
             elif ev.event_type == EventType.ChildWorkflowExecutionStarted:
                 flushed_child_started[attrs.get("initiated_event_id")] = real.id
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_DECISION_COMPLETED,
                          m.M_BUFFERED_FLUSHED, len(normal) + len(closes))
         return len(normal) + len(closes)
@@ -297,6 +298,7 @@ class HistoryEngine:
     # StartWorkflowExecution (historyEngine.go:547, startWorkflowHelper:583)
     # ------------------------------------------------------------------
 
+    @tracing.traced(m.SCOPE_HISTORY_START_WORKFLOW)
     def start_workflow(self, domain_id: str, workflow_id: str,
                        workflow_type: str, task_list: str,
                        execution_timeout: int = 3600,
@@ -312,7 +314,6 @@ class HistoryEngine:
                        attempt: int = 0,
                        expiration_timestamp: int = 0,
                        initial_signals: Sequence[str] = ()) -> str:
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_START_WORKFLOW, m.M_REQUESTS)
         run_id = run_id or str(uuid.uuid4())
         # duplicate check BEFORE any write (the create fence still guards
@@ -407,6 +408,7 @@ class HistoryEngine:
     # Decision task lifecycle (decision/handler.go)
     # ------------------------------------------------------------------
 
+    @tracing.traced(m.SCOPE_HISTORY_RECORD_STARTED)
     def record_decision_task_started(self, domain_id: str, workflow_id: str,
                                      run_id: str, schedule_id: int,
                                      request_id: str) -> TaskToken:
@@ -451,6 +453,7 @@ class HistoryEngine:
         DecisionType.ContinueAsNewWorkflowExecution,
     })
 
+    @tracing.traced(m.SCOPE_HISTORY_DECISION_COMPLETED)
     def respond_decision_task_completed(self, token: TaskToken,
                                         decisions: List[Decision],
                                         sticky_task_list: str = "",
@@ -471,7 +474,6 @@ class HistoryEngine:
         decision dispatch to the worker's sticky task list; absent
         attributes clear stickyness (workflowHandler →
         historyEngine.go RespondDecisionTaskCompleted sticky handling)."""
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_DECISION_COMPLETED, m.M_REQUESTS)
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
         info = ms.execution_info
@@ -952,6 +954,7 @@ class HistoryEngine:
     # Signals / cancel / terminate (historyEngine.go:2202,:2629 region)
     # ------------------------------------------------------------------
 
+    @tracing.traced(m.SCOPE_HISTORY_SIGNAL)
     def signal_workflow(self, domain_id: str, workflow_id: str,
                         signal_name: str, run_id: Optional[str] = None,
                         request_id: Optional[str] = None) -> None:
@@ -959,7 +962,6 @@ class HistoryEngine:
         SignalWorkflowExecution's IsSignalRequested/AddSignalRequested): a
         redelivered signal with an already-applied request id is a no-op
         instead of a duplicate WorkflowExecutionSignaled event."""
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_SIGNAL, m.M_REQUESTS)
         ms, expected = self._load(domain_id, workflow_id, run_id)
         self._require_running(ms)
@@ -1079,7 +1081,6 @@ class HistoryEngine:
         with a reset cause, signals recorded after the reset point are
         re-applied (ndc/events_reapplier.go), and the new run becomes
         current; a still-running base run is terminated first."""
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_HISTORY_RESET, m.M_REQUESTS)
         base_ms, _ = self._load(domain_id, workflow_id, run_id)
         base_info = base_ms.execution_info
@@ -1434,7 +1435,6 @@ class HistoryEngine:
         self.notifier.forget(key)
         self.queries.drop_key(key)
         if deleted:
-            from ..utils import metrics as m
             self.metrics.inc(m.SCOPE_WORKER_RETENTION, m.M_RUNS_DELETED)
         return deleted
 
@@ -1472,7 +1472,6 @@ class HistoryEngine:
         threshold the run is TERMINATED — unbounded growth is how one
         workflow takes down a shard (host/size_limit_test.go; the
         reference enforces in workflowExecutionContext's transaction)."""
-        from ..utils import metrics as _m
         from .limits import TERMINATE_REASON, history_limits
 
         info = ms.execution_info

@@ -30,6 +30,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..utils import metrics as m
+from ..utils import tracing
 from .persistence import PersistedTask, Stores, TaskListInfo
 
 TASK_LIST_TYPE_DECISION = 0
@@ -254,8 +256,7 @@ class _TaskListManager:
                     # retries from the advanced level) but NEVER silent —
                     # a programming error or corrupted store must surface
                     from ..utils.log import DEFAULT_LOGGER
-                    from ..utils.metrics import DEFAULT_REGISTRY
-                    DEFAULT_REGISTRY.inc("matching", "task-gc-failures")
+                    m.DEFAULT_REGISTRY.inc("matching", "task-gc-failures")
                     DEFAULT_LOGGER.warning(
                         "task GC deferred", component="matching",
                         task_list=self._info.name, level=self._ack,
@@ -353,6 +354,7 @@ class MatchingEngine:
         local.add(domain_id, workflow_id, run_id, schedule_id, base=base,
                   forward_to=root)
 
+    @tracing.traced(m.SCOPE_MATCHING_ADD_DECISION)
     def add_decision_task(self, domain_id: str, task_list: str,
                           workflow_id: str, run_id: str, schedule_id: int,
                           partition: Optional[int] = None) -> None:
@@ -505,6 +507,7 @@ class MatchingEngine:
                            task_list=task_list, task_id=task.task_id,
                            source=src)
 
+    @tracing.traced(m.SCOPE_MATCHING_POLL_DECISION)
     def poll_and_wait_decision(self, domain_id: str, task_list: str,
                                wait_seconds: float = 0, identity: str = ""
                                ) -> Optional[MatchedTask]:

@@ -40,6 +40,10 @@ class Onebox:
         #: runtime knobs (common/dynamicconfig analog) + cluster metrics
         self.config = config if config is not None else DynamicConfig()
         self.metrics = MetricsRegistry()
+        #: the shared tracer (traced components default to it; tests read
+        #: box.tracer.traces() for stitched frontend→history→matching calls)
+        from ..utils import tracing
+        self.tracer = tracing.DEFAULT_TRACER
         # authorization seam (authorizer.go:88): Noop unless the operator
         # wires a real authorizer; AdminHandler and the frontend consult it
         from .authorization import NoopAuthorizer
@@ -165,6 +169,25 @@ class Onebox:
 
     def advance_time(self, seconds: float) -> None:
         self.clock.advance(int(seconds * NANOS))
+
+    # -- observability -----------------------------------------------------
+
+    def scrape_server(self, address=("127.0.0.1", 0)):
+        """An HTTP /metrics + /health + /traces surface over this box's
+        registry (the same component rpc/server.ServiceHost mounts);
+        caller starts/stops it."""
+        from ..utils.scrape import ObservabilityHTTPServer
+
+        def health():
+            # liveness only — no O(executions) store walks in a probe a
+            # poller may hit every few seconds (describe_cluster carries
+            # the expensive rollups)
+            return {"status": "ok", "cluster": self.cluster_name,
+                    "hosts": list(self.hosts),
+                    "matching_backlog": self.matching.backlog()}
+
+        return ObservabilityHTTPServer(self.metrics, health_fn=health,
+                                       tracer=self.tracer, address=address)
 
     # -- recovery ----------------------------------------------------------
 

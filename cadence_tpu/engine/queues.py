@@ -21,6 +21,7 @@ from ..core.enums import (
     TransferTaskType,
 )
 from ..oracle.mutable_state import GeneratedTask
+from ..utils import metrics as m
 from ..utils.clock import TimeSource
 from ..utils.metrics import SCOPE_QUEUE_TIMER, SCOPE_QUEUE_TRANSFER
 from .history_engine import InvalidRequestError
@@ -174,7 +175,6 @@ class QueueProcessors:
         gone) — counted so the drops are visible (VERDICT r2 missing #4:
         'every queue executor that swallows EntityNotExistsError does so
         invisibly')."""
-        from ..utils import metrics as m
         self.metrics.inc(queue_scope, m.M_TASKS_DROPPED_NOT_EXISTS)
 
     # ------------------------------------------------------------------
@@ -269,12 +269,10 @@ class QueueProcessors:
             # policy); merge drained splits the base has caught up past
             for domain_id, n in base_pending.items():
                 if n > threshold and state.split(domain_id, max_level):
-                    from ..utils import metrics as m
                     self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, "queue-splits")
                     self.log_split(shard_id, domain_id, n)
             merged = state.merge_drained()
             if merged:
-                from ..utils import metrics as m
                 self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, "queue-merges",
                                  merged)
             state.pending = base_pending
@@ -288,7 +286,6 @@ class QueueProcessors:
                     self._transfer_queues.pop(shard_id, None)
                 except (TransientStoreError, ConnectionError):
                     pass  # deferred: the next sweep re-persists
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, m.M_TASKS_PROCESSED,
                          submitted)
         return submitted
@@ -319,7 +316,6 @@ class QueueProcessors:
                 processed += 1
             if tasks:
                 shard.update_transfer_ack_level(max_seen)
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_QUEUE_TRANSFER, m.M_TASKS_PROCESSED, processed)
         return processed
 
@@ -626,7 +622,6 @@ class QueueProcessors:
                                         run_id, task)
                     shard.update_timer_ack_level(task_id)
                     fired += 1
-        from ..utils import metrics as m
         self.metrics.inc(m.SCOPE_QUEUE_TIMER, m.M_TASKS_PROCESSED, fired)
         return fired
 

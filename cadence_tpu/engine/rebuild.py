@@ -103,16 +103,28 @@ class DeviceRebuilder:
         if not jobs:
             return []
         from ..utils import metrics as m
+        from ..utils.profiler import ReplayProfiler
         scope = self.metrics.scope(m.SCOPE_REBUILD)
+        # rebuilds profile under their own scope so a reset/recovery storm
+        # is distinguishable from bulk-verify traffic in the same scrape
+        prof = ReplayProfiler(self.metrics, scope=m.SCOPE_REBUILD)
         max_events = max(history_length(b) for b, _ in jobs)
-        corpus = encode_corpus([b for b, _ in jobs], max_events)
+        with prof.leg(m.M_PROFILE_PACK):
+            corpus = encode_corpus([b for b, _ in jobs], max_events)
         total_events = sum(history_length(b) for b, _ in jobs)
         try:
             with scope.timed():
-                state, _log = replay_events_with_tasks(jnp.asarray(corpus),
-                                                       self.layout)
-                rows = np.asarray(payload_rows(state, self.layout))
-                arrs = jax.device_get(state)
+                with prof.leg(m.M_PROFILE_H2D):
+                    device_corpus = jax.device_put(jnp.asarray(corpus))
+                    prof.h2d(corpus.nbytes)
+                with prof.leg(m.M_PROFILE_KERNEL):
+                    state, _log = replay_events_with_tasks(device_corpus,
+                                                           self.layout)
+                    rows_dev = payload_rows(state, self.layout)
+                    jax.block_until_ready(rows_dev)
+                with prof.leg(m.M_PROFILE_READBACK):
+                    rows = np.asarray(rows_dev)
+                    arrs = jax.device_get(state)
             scope.inc(m.M_KERNEL_LAUNCHES)
             scope.inc(m.M_EVENTS_REPLAYED, total_events)
         except RuntimeError:

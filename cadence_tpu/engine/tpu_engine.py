@@ -101,7 +101,12 @@ class TPUReplayEngine:
     def replay_tree_payloads(self, keys: Sequence[Tuple[str, str, str]]
                              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Device-replay full branch trees (divergent histories included);
-        returns (payload rows, errors, device-chosen current branch)."""
+        returns (payload rows, errors, device-chosen current branch).
+
+        Each launch is decomposed into pack/h2d/kernel/readback legs by a
+        ReplayProfiler, so the end-to-end latency timer can be diffed
+        leg-by-leg from any scrape."""
+        import jax
         import jax.numpy as jnp
 
         from ..ops.encode import encode_segment_corpus
@@ -109,15 +114,26 @@ class TPUReplayEngine:
         from ..ops.replay import replay_events
 
         from ..utils import metrics as m
+        from ..utils.profiler import ReplayProfiler
         scope = self.metrics.scope(m.SCOPE_TPU_REPLAY)
-        corpus = encode_segment_corpus([self.tree_segments(k) for k in keys])
+        prof = ReplayProfiler(self.metrics)
+        with prof.leg(m.M_PROFILE_PACK):
+            corpus = encode_segment_corpus(
+                [self.tree_segments(k) for k in keys])
         real_events = int((corpus[:, :, 0] > 0).sum())
         scope.inc(m.M_KERNEL_LAUNCHES)
         scope.inc(m.M_EVENTS_REPLAYED, real_events)
-        with scope.timed() :
-            state = replay_events(jnp.asarray(corpus), self.layout)
-            rows = np.asarray(payload_rows(state, self.layout))
-            errors = np.asarray(state.error)
+        with scope.timed():
+            with prof.leg(m.M_PROFILE_H2D):
+                device_corpus = jax.device_put(jnp.asarray(corpus))
+                prof.h2d(corpus.nbytes)
+            with prof.leg(m.M_PROFILE_KERNEL):
+                state = replay_events(device_corpus, self.layout)
+                rows_dev = payload_rows(state, self.layout)
+                jax.block_until_ready(rows_dev)
+            with prof.leg(m.M_PROFILE_READBACK):
+                rows = np.asarray(rows_dev)
+                errors = np.asarray(state.error)
         t = self.metrics.timer(m.SCOPE_TPU_REPLAY, m.M_LATENCY)
         if t.total_s > 0:
             self.metrics.gauge(

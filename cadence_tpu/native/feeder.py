@@ -66,13 +66,20 @@ def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
     total = len(blobs)
     report = FeedReport(workflows=total)
     depth = 2
+    from ..utils import metrics as m
+    from ..utils.profiler import ReplayProfiler
+
+    prof = ReplayProfiler()
     buffers = [np.empty((chunk_workflows, max_events, num_lanes),
                         dtype=dtype) for _ in range(depth)]
     start = time.perf_counter()
     device_outs: List[Tuple] = []
     for ci, lo in enumerate(range(0, total, chunk_workflows)):
         if ci >= depth:
-            jax.block_until_ready(device_outs[ci - depth])
+            # the wait for an in-flight chunk IS the kernel leg of the
+            # pipeline: any host time spent here is device-bound
+            with prof.leg(m.M_PROFILE_KERNEL):
+                jax.block_until_ready(device_outs[ci - depth])
         chunk = list(blobs[lo:lo + chunk_workflows])
         pad = chunk_workflows - len(chunk)
         if pad:
@@ -80,13 +87,21 @@ def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
         t0 = time.perf_counter()
         packed = pack_fn(chunk, max_events, num_threads=num_threads,
                          out=buffers[ci % depth])
-        report.pack_s += time.perf_counter() - t0
+        pack_dt = time.perf_counter() - t0
+        report.pack_s += pack_dt
+        prof.observe(m.M_PROFILE_PACK, pack_dt)
         report.events += int((packed[:, :, 0] > 0).sum())
         # async dispatch: the device crunches while the next chunk packs
-        device_outs.append(replay_fn(jax.device_put(packed), layout))
+        with prof.leg(m.M_PROFILE_H2D):
+            device_chunk = jax.device_put(packed)
+            prof.h2d(packed.nbytes)
+        device_outs.append(replay_fn(device_chunk, layout))
         report.chunks += 1
-    first = np.concatenate([np.asarray(r) for r, _ in device_outs])[:total]
-    errors = np.concatenate([np.asarray(e) for _, e in device_outs])[:total]
+    with prof.leg(m.M_PROFILE_READBACK):
+        first = np.concatenate(
+            [np.asarray(r) for r, _ in device_outs])[:total]
+        errors = np.concatenate(
+            [np.asarray(e) for _, e in device_outs])[:total]
     report.wall_s = time.perf_counter() - start
     return first, errors, report
 
@@ -143,7 +158,10 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
 
     from ..ops.replay import replay_wirec_to_crc
     from ..ops.wirec import ProfileMisfit, pack_wirec
+    from ..utils import metrics as m
+    from ..utils.profiler import ReplayProfiler
 
+    prof = ReplayProfiler()
     total = len(blobs)
     report = FeedReport(workflows=total)
     depth = 2
@@ -154,7 +172,8 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
     device_outs: List[Tuple] = []
     for ci, lo in enumerate(range(0, total, chunk_workflows)):
         if ci >= depth:
-            jax.block_until_ready(device_outs[ci - depth])
+            with prof.leg(m.M_PROFILE_KERNEL):
+                jax.block_until_ready(device_outs[ci - depth])
         chunk = list(blobs[lo:lo + chunk_workflows])
         pad = chunk_workflows - len(chunk)
         if pad:
@@ -163,7 +182,8 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
         packed = packing.pack_serialized(chunk, max_events,
                                          num_threads=num_threads,
                                          out=buffers[ci % depth])
-        report.pack_s += time.perf_counter() - t0
+        pack_dt = time.perf_counter() - t0
+        report.pack_s += pack_dt
         t0 = time.perf_counter()
         try:
             corpus = pack_wirec(packed, profile=profile)
@@ -171,15 +191,24 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
             corpus = pack_wirec(packed)  # refit: fresh plan, recompile
             report.profile_refits += 1
         profile = corpus.profile
-        report.compress_s += time.perf_counter() - t0
+        compress_dt = time.perf_counter() - t0
+        report.compress_s += compress_dt
+        # compression is part of the host pack cost in this pipeline
+        prof.observe(m.M_PROFILE_PACK, pack_dt + compress_dt)
         report.events += int(corpus.n_events.sum())
         report.wire_bytes += corpus.wire_bytes
-        device_outs.append(replay_wirec_to_crc(
-            jax.device_put(corpus.slab), jax.device_put(corpus.bases),
-            jax.device_put(corpus.n_events), profile, layout))
+        with prof.leg(m.M_PROFILE_H2D):
+            parts = (jax.device_put(corpus.slab),
+                     jax.device_put(corpus.bases),
+                     jax.device_put(corpus.n_events))
+            prof.h2d(corpus.wire_bytes)
+        device_outs.append(replay_wirec_to_crc(*parts, profile, layout))
         report.chunks += 1
-    first = np.concatenate([np.asarray(r) for r, _ in device_outs])[:total]
-    errors = np.concatenate([np.asarray(e) for _, e in device_outs])[:total]
+    with prof.leg(m.M_PROFILE_READBACK):
+        first = np.concatenate(
+            [np.asarray(r) for r, _ in device_outs])[:total]
+        errors = np.concatenate(
+            [np.asarray(e) for _, e in device_outs])[:total]
     report.wall_s = time.perf_counter() - start
     return first, errors, report
 

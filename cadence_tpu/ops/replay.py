@@ -165,8 +165,21 @@ def replay_corpus(histories: Sequence[Sequence[HistoryBatch]],
                   max_events: int = 0,
                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host helper: encode histories, replay on the default backend, and
-    return (payload_rows, crc32s, errors) as numpy arrays."""
-    events = encode_corpus(histories, max_events)
-    rows, errors = replay_to_payload(jnp.asarray(events), layout)
-    rows_np = np.asarray(rows)
-    return rows_np, crc32_of_rows(rows_np), np.asarray(errors)
+    return (payload_rows, crc32s, errors) as numpy arrays. Legs land in
+    the default registry's SCOPE_TPU_REPLAY histograms (utils/profiler)."""
+    from ..utils import metrics as m
+    from ..utils.profiler import ReplayProfiler
+
+    prof = ReplayProfiler()
+    with prof.leg(m.M_PROFILE_PACK):
+        events = encode_corpus(histories, max_events)
+    with prof.leg(m.M_PROFILE_H2D):
+        device_events = jax.device_put(jnp.asarray(events))
+        prof.h2d(events.nbytes)
+    with prof.leg(m.M_PROFILE_KERNEL):
+        rows, errors = replay_to_payload(device_events, layout)
+        jax.block_until_ready(rows)
+    with prof.leg(m.M_PROFILE_READBACK):
+        rows_np = np.asarray(rows)
+        errors_np = np.asarray(errors)
+    return rows_np, crc32_of_rows(rows_np), errors_np

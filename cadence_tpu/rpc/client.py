@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Tuple
 
+from ..utils import tracing
 from .wire import Connection
 
 #: every sub-store a Stores bundle exposes (persistence.Stores fields)
@@ -50,7 +51,9 @@ class _Pool:
         if conn is None:
             conn = Connection(self.address)
             self._local.conn = conn
-        return conn.call(request)
+        # the calling thread's active span rides the envelope, so the
+        # serving side parents its span on ours (cross-hop stitching)
+        return conn.call(tracing.inject(request))
 
 
 class RemoteStores:

@@ -77,11 +77,14 @@ class FrontendClient:
 class Cluster:
     def __init__(self, store_port: int, hosts: Dict[str, int],
                  procs: Dict[str, subprocess.Popen],
-                 store_proc: subprocess.Popen) -> None:
+                 store_proc: subprocess.Popen,
+                 http_ports: Dict[str, int] = None) -> None:
         self.store_port = store_port
         self.hosts = hosts          # name → port
         self.procs = procs          # name → process
         self.store_proc = store_proc
+        #: name → HTTP scrape port (/metrics, /health, /traces)
+        self.http_ports = dict(http_ports or {})
 
     def frontend(self, index_or_name) -> FrontendClient:
         name = (index_or_name if isinstance(index_or_name, str)
@@ -318,19 +321,23 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
 
     hosts: Dict[str, int] = {}
     procs: Dict[str, subprocess.Popen] = {}
+    http_ports: Dict[str, int] = {}
     for i in range(num_hosts):
         name = f"{cluster_name}-host-{i}" if peer_specs else f"host-{i}"
         port = free_port()
+        http_port = free_port()
         cmd = [sys.executable, "-m", "cadence_tpu.rpc.server",
                "--name", name, "--port", str(port),
                "--store", f"127.0.0.1:{store_port}",
                "--num-shards", str(num_shards),
                "--hb-interval", str(hb_interval), "--ttl", str(ttl),
-               "--cluster-name", cluster_name]
+               "--cluster-name", cluster_name,
+               "--http-port", str(http_port)]
         for spec in peer_specs:
             cmd += ["--peer", spec]
         procs[name] = subprocess.Popen(cmd, env=env)
         hosts[name] = port
+        http_ports[name] = http_port
     for name, port in hosts.items():
         _wait_listening(port, procs[name])
     # let every host's RING converge on the full peer set before handing
@@ -349,4 +356,5 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
         if all(v >= want for v in views):
             break
         time.sleep(0.05)
-    return Cluster(store_port, hosts, procs, store_proc)
+    return Cluster(store_port, hosts, procs, store_proc,
+                   http_ports=http_ports)

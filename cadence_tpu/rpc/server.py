@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import socketserver
 import threading
+from contextlib import nullcontext
 from typing import Dict, Optional, Tuple
 
 from ..engine.controller import ShardController, ShardNotOwnedError
@@ -35,6 +36,7 @@ from ..engine.history_engine import HistoryEngine
 from ..engine.matching import MatchingEngine
 from ..engine.membership import HashRing
 from ..engine.queues import QueueProcessors
+from ..utils import tracing
 from ..utils.clock import RealTimeSource
 from .client import RemoteEngine, RemoteMatching, RemoteStores
 from .wire import recv_frame, send_frame, verify_hello
@@ -139,7 +141,8 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                  pump_interval: float = 0.05,
                  cluster_name: str = "primary",
                  peers: Optional[Dict[str, Tuple[str, int]]] = None,
-                 advertise_host: str = "127.0.0.1") -> None:
+                 advertise_host: str = "127.0.0.1",
+                 http_port: int = 0) -> None:
         super().__init__(address, _Handler)
         from ..utils import compile_cache
         from ..utils.dynamicconfig import DynamicConfig
@@ -166,6 +169,13 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.clock = RealTimeSource()
         self.config = DynamicConfig()
         self.metrics = MetricsRegistry()
+        self.tracer = tracing.DEFAULT_TRACER
+        #: HTTP scrape surface (/metrics, /health, /traces): bound in
+        #: __init__ so the port is known before start(); 0 = ephemeral
+        from ..utils.scrape import ObservabilityHTTPServer
+        self.scrape = ObservabilityHTTPServer(
+            self.metrics, health_fn=self._health, tracer=self.tracer,
+            address=(address[0], http_port))
         #: shared across every engine this host creates (multi-cluster
         #: replication publish seam)
         self._publisher_holder: Dict[str, object] = {"pub": None}
@@ -337,6 +347,14 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         return owner, self._peer_addresses.get(
             owner, (self.advertise_host, self.port))
 
+    # -- health (the /health probe body) -----------------------------------
+
+    def _health(self) -> Dict[str, object]:
+        return {"status": "ok", "name": self.name,
+                "cluster": self.cluster_name,
+                "owned_shards": sorted(self.controller.owned_shards()),
+                "ring": sorted(self.ring.members())}
+
     # -- membership --------------------------------------------------------
 
     def _beat_loop(self) -> None:
@@ -386,10 +404,15 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.refresh_membership()
         self._beat_thread.start()
         self._pump_thread.start()
+        self.scrape.start()
         threading.Thread(target=self.serve_forever, daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            self.scrape.stop()
+        except Exception:
+            pass
         self.shutdown()
 
 
@@ -417,51 +440,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = recv_frame(self.request)
             except (OSError, ConnectionError):
                 return
+            # a traced envelope parents this request's span on the caller's
+            # span; untraced traffic (pump loops, heartbeats) stays span-free
+            remote_ctx, req = tracing.extract(req)
             matched_poll = None  # (task, task_type) needing dead-socket requeue
             try:
-                op = req[0]
-                if op == "frontend":
-                    _, method, args, kwargs = req
-                    result = getattr(server.frontend, method)(*args, **kwargs)
-                elif op == "engine":
-                    _, workflow_id, path, args, kwargs = req
-                    target = server.controller.engine_for_workflow(workflow_id)
-                    for part in path.split("."):
-                        target = getattr(target, part)
-                    result = target(*args, **kwargs)
-                elif op == "engine_routed":
-                    # cross-CLUSTER entry: any host accepts and forwards to
-                    # its ring's owner (server.route), so a peer cluster
-                    # needs only one live address, not our ring topology
-                    _, workflow_id, path, args, kwargs = req
-                    target = server.route(workflow_id)
-                    for part in path.split("."):
-                        target = getattr(target, part)
-                    result = target(*args, **kwargs)
-                elif op == "matching":
-                    _, method, args, kwargs = req
-                    result = getattr(server.matching.local, method)(*args,
-                                                                    **kwargs)
-                    if method in _MATCHING_POLLS and result is not None:
-                        matched_poll = (result, _MATCHING_POLLS[method])
-                elif op == "admin_stale_probe":
-                    # deposed-owner fencing probe: write through the CACHED
-                    # shard engine, bypassing ring validation — the range
-                    # fence in the store server must reject it
-                    _, domain_id, workflow_id = req
-                    sid = server.controller.shard_for(workflow_id)
-                    engine = server.controller.cached_engine(sid)
-                    if engine is None:
-                        raise RuntimeError(f"no cached engine for shard {sid}")
-                    engine.signal_workflow(domain_id, workflow_id,
-                                           "stale-probe")
-                    result = None
-                elif op == "ping":
-                    result = ("pong", server.name,
-                              server.controller.owned_shards(),
-                              server.ring.members())
-                else:
-                    raise ValueError(f"unknown op {op!r}")
+                op = req[0] if isinstance(req, tuple) and req else "?"
+                span_cm = (server.tracer.start_span(f"rpc.{op}",
+                                                    child_of=remote_ctx)
+                           if remote_ctx is not None else nullcontext())
+                with span_cm:
+                    result, matched_poll = self._dispatch(server, req)
                 response = ("ok", result)
             except BaseException as exc:
                 response = ("err", exc)
@@ -482,6 +471,58 @@ class _Handler(socketserver.BaseRequestHandler):
                 except Exception:
                     return
 
+    @staticmethod
+    def _dispatch(server: "ServiceHost", req) -> Tuple[object, Optional[tuple]]:
+        """Execute one op → (result, matched_poll)."""
+        matched_poll = None
+        op = req[0]
+        if op == "frontend":
+            _, method, args, kwargs = req
+            result = getattr(server.frontend, method)(*args, **kwargs)
+        elif op == "engine":
+            _, workflow_id, path, args, kwargs = req
+            target = server.controller.engine_for_workflow(workflow_id)
+            for part in path.split("."):
+                target = getattr(target, part)
+            result = target(*args, **kwargs)
+        elif op == "engine_routed":
+            # cross-CLUSTER entry: any host accepts and forwards to
+            # its ring's owner (server.route), so a peer cluster
+            # needs only one live address, not our ring topology
+            _, workflow_id, path, args, kwargs = req
+            target = server.route(workflow_id)
+            for part in path.split("."):
+                target = getattr(target, part)
+            result = target(*args, **kwargs)
+        elif op == "matching":
+            _, method, args, kwargs = req
+            result = getattr(server.matching.local, method)(*args, **kwargs)
+            if method in _MATCHING_POLLS and result is not None:
+                matched_poll = (result, _MATCHING_POLLS[method])
+        elif op == "admin_stale_probe":
+            # deposed-owner fencing probe: write through the CACHED
+            # shard engine, bypassing ring validation — the range
+            # fence in the store server must reject it
+            _, domain_id, workflow_id = req
+            sid = server.controller.shard_for(workflow_id)
+            engine = server.controller.cached_engine(sid)
+            if engine is None:
+                raise RuntimeError(f"no cached engine for shard {sid}")
+            engine.signal_workflow(domain_id, workflow_id, "stale-probe")
+            result = None
+        elif op == "admin_metrics":
+            # the scrape surface as an RPC (operator tooling that already
+            # speaks the wire need not open the HTTP port)
+            result = {"snapshot": server.metrics.snapshot(),
+                      "prometheus": server.metrics.to_prometheus()}
+        elif op == "ping":
+            result = ("pong", server.name,
+                      server.controller.owned_shards(),
+                      server.ring.members())
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        return result, matched_poll
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cadence-tpu-host")
@@ -500,6 +541,9 @@ def main(argv=None) -> int:
                    help="address peers dial to reach this host (defaults "
                         "to --host, or 127.0.0.1 when binding 0.0.0.0; "
                         "containers pass their service name)")
+    p.add_argument("--http-port", type=int, default=0,
+                   help="HTTP scrape port (/metrics, /health, /traces); "
+                        "0 binds an ephemeral port")
     args = p.parse_args(argv)
     shost, sport = args.store.rsplit(":", 1)
     peers = {}
@@ -513,7 +557,7 @@ def main(argv=None) -> int:
                        (shost, int(sport)), args.num_shards,
                        hb_interval=args.hb_interval, ttl=args.ttl,
                        cluster_name=args.cluster_name, peers=peers,
-                       advertise_host=advertise)
+                       advertise_host=advertise, http_port=args.http_port)
     host.start()
     threading.Event().wait()  # serve until killed
     return 0

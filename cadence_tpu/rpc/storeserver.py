@@ -25,9 +25,11 @@ import argparse
 import socketserver
 import threading
 import time
+from contextlib import nullcontext
 from typing import Dict, Tuple
 
 from ..engine.persistence import Stores
+from ..utils import tracing
 from .wire import recv_frame, send_frame, verify_hello
 
 
@@ -72,22 +74,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = recv_frame(self.request)
             except (OSError, ConnectionError):
                 return
+            # engine transactions traced at a service host propagate here
+            # too, so store round-trips appear inside the same trace
+            remote_ctx, req = tracing.extract(req)
             try:
                 op = req[0]
-                if op == "store":
-                    _, sub, method, args, kwargs = req
-                    target = getattr(server.stores, sub)
-                    result = getattr(target, method)(*args, **kwargs)
-                elif op == "hb":
-                    server.heartbeat(req[1], req[2],
-                                     req[3] if len(req) > 3 else "127.0.0.1")
-                    result = None
-                elif op == "peers":
-                    result = server.peers(req[1])
-                elif op == "ping":
-                    result = "pong"
-                else:
-                    raise ValueError(f"unknown op {op!r}")
+                span_cm = (tracing.DEFAULT_TRACER.start_span(
+                               f"rpc.{op}", child_of=remote_ctx)
+                           if remote_ctx is not None else nullcontext())
+                with span_cm:
+                    result = self._dispatch(server, req)
                 response = ("ok", result)
             except BaseException as exc:  # service errors cross the wire
                 response = ("err", exc)
@@ -101,6 +97,23 @@ class _Handler(socketserver.BaseRequestHandler):
                                ("err", RuntimeError(repr(response[1]))))
                 except Exception:
                     return
+
+    @staticmethod
+    def _dispatch(server: "StoreServer", req):
+        op = req[0]
+        if op == "store":
+            _, sub, method, args, kwargs = req
+            target = getattr(server.stores, sub)
+            return getattr(target, method)(*args, **kwargs)
+        if op == "hb":
+            server.heartbeat(req[1], req[2],
+                             req[3] if len(req) > 3 else "127.0.0.1")
+            return None
+        if op == "peers":
+            return server.peers(req[1])
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
 
 
 def serve(port: int, wal: str = "", host: str = "127.0.0.1") -> None:

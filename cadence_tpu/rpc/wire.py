@@ -23,6 +23,8 @@ import socket
 import struct
 from typing import Any, Optional, Tuple
 
+from ..utils import tracing
+
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
 
@@ -124,7 +126,7 @@ def call(address: Tuple[str, int], request: Any, timeout: float = 30.0) -> Any:
     the process boundary."""
     with socket.create_connection(address, timeout=timeout) as sock:
         send_hello(sock)
-        send_frame(sock, request)
+        send_frame(sock, tracing.inject(request))
         kind, payload = recv_frame(sock)
     if kind == "err":
         raise payload

@@ -1,21 +1,28 @@
-"""Metrics: counters / timers / gauges behind named scopes.
+"""Metrics: counters / timers / gauges / histograms behind named scopes.
 
 Reference: common/metrics (Client/Scope at metrics/interfaces.go:31,:53;
 every scope and metric name enumerated in metrics/defs.go). The reference
 emits through tally to m3/statsd/prometheus; here the registry keeps the
-aggregates in-process (snapshot() is the emitter seam — a prometheus
-text-format dump or a push client would read the same structure) so tests
-and the bench can assert on what the engine actually measured.
+aggregates in-process and exposes two emitter seams: snapshot() (the
+structured dump tests and the bench assert on, now with percentiles) and
+to_prometheus() (text exposition format 0.0.4, served by the /metrics
+scrape surface in utils/scrape.py and rpc/server.py).
+
+Timers feed fixed-bucket histograms on every record(), so each latency
+metric carries a full distribution (bucket counts + interpolated
+percentiles), not just count/total/max.
 
 Thread-safe; scopes are cheap handles over the shared registry.
 """
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 # -- scope names (metrics/defs.go analog; the subset the engine emits) ------
@@ -35,6 +42,10 @@ SCOPE_REBUILD = "tpu.device-rebuilder"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
+SCOPE_HISTORY_RECORD_STARTED = "history.record-decision-task-started"
+SCOPE_FRONTEND_POLL_DECISION = "frontend.poll-for-decision-task"
+SCOPE_MATCHING_POLL_DECISION = "matching.poll-decision-task"
+SCOPE_MATCHING_ADD_DECISION = "matching.add-decision-task"
 
 # -- metric names -----------------------------------------------------------
 
@@ -59,6 +70,66 @@ M_RUNS_DELETED = "runs-deleted"
 M_RUNS_ARCHIVED = "runs-archived"
 M_EXECUTIONS_SCANNED = "executions-scanned"
 M_INVARIANT_VIOLATIONS = "invariant-violations"
+#: replay-profiler legs (utils/profiler.py): per-kernel-launch host cost
+M_PROFILE_PACK = "pack"
+M_PROFILE_H2D = "h2d"
+M_PROFILE_KERNEL = "kernel"
+M_PROFILE_READBACK = "readback"
+M_H2D_BYTES = "h2d-bytes"
+
+
+#: latency buckets (seconds): sub-ms sync paths through multi-second
+#: device compiles — tally's default histogram ladder, trimmed
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: byte-size buckets (h2d transfer sizes: KBs to the 256MB frame cap)
+BYTE_BUCKETS: Tuple[float, ...] = (
+    1024.0, 16384.0, 262144.0, 1048576.0, 16777216.0, 268435456.0)
+
+
+class HistogramStat:
+    """Fixed-bucket histogram (prometheus `le` semantics: bucket i counts
+    values <= bounds[i]; the last slot is +Inf)."""
+
+    __slots__ = ("bounds", "bucket_counts", "count", "total")
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        self.bucket_counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.bucket_counts[bisect.bisect_left(self.bounds, value)] += 1
+
+    def cumulative(self) -> List[Tuple[str, int]]:
+        """[(le_label, cumulative_count)] ending with ("+Inf", count)."""
+        out: List[Tuple[str, int]] = []
+        running = 0
+        for bound, n in zip(self.bounds, self.bucket_counts):
+            running += n
+            out.append((str(bound), running))
+        out.append(("+Inf", self.count))
+        return out
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]; linear interpolation inside the covering bucket.
+        Values in the +Inf bucket clamp to the top finite bound."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        running = 0
+        lo = 0.0
+        for bound, n in zip(self.bounds, self.bucket_counts):
+            if n and running + n >= target:
+                return lo + (bound - lo) * ((target - running) / n)
+            running += n
+            lo = bound
+        return self.bounds[-1]
 
 
 @dataclass
@@ -81,6 +152,7 @@ class MetricsRegistry:
         self._counters: Dict[Tuple[str, str], int] = {}
         self._timers: Dict[Tuple[str, str], _TimerStat] = {}
         self._gauges: Dict[Tuple[str, str], float] = {}
+        self._histograms: Dict[Tuple[str, str], HistogramStat] = {}
 
     def scope(self, name: str) -> "Scope":
         return Scope(self, name)
@@ -93,8 +165,25 @@ class MetricsRegistry:
                 self._counters.get((scope, name), 0) + delta)
 
     def record(self, scope: str, name: str, seconds: float) -> None:
+        """Timer + latency histogram: every record() feeds both, so each
+        latency metric carries a full distribution."""
         with self._lock:
             self._timers.setdefault((scope, name), _TimerStat()).record(seconds)
+            hist = self._histograms.get((scope, name))
+            if hist is None:
+                hist = self._histograms[(scope, name)] = HistogramStat()
+            hist.observe(seconds)
+
+    def observe(self, scope: str, name: str, value: float,
+                buckets: Optional[Sequence[float]] = None) -> None:
+        """Histogram-only observation (sizes, per-leg timings); `buckets`
+        applies on first touch of the (scope, name) series."""
+        with self._lock:
+            hist = self._histograms.get((scope, name))
+            if hist is None:
+                hist = self._histograms[(scope, name)] = HistogramStat(
+                    buckets if buckets is not None else DEFAULT_BUCKETS)
+            hist.observe(value)
 
     def gauge(self, scope: str, name: str, value: float) -> None:
         with self._lock:
@@ -115,8 +204,28 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.get((scope, name), default)
 
+    def histogram(self, scope: str, name: str) -> HistogramStat:
+        with self._lock:
+            return self._histograms.get((scope, name), HistogramStat())
+
+    def percentiles(self, scope: str, name: str,
+                    qs: Sequence[float] = (0.5, 0.95, 0.99)
+                    ) -> Dict[str, float]:
+        hist = self.histogram(scope, name)
+        return {f"p{round(q * 100):d}": hist.percentile(q) for q in qs}
+
+    def reset(self) -> None:
+        """Drop every series (the per-test isolation seam: components hold
+        the registry by reference, so clearing in place is the only reset
+        that reaches them all)."""
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
     def snapshot(self) -> Dict[str, Dict[str, object]]:
-        """Full dump, grouped by scope — the emitter seam."""
+        """Full dump, grouped by scope — the structured emitter seam."""
         out: Dict[str, Dict[str, object]] = {}
         with self._lock:
             for (scope, name), v in self._counters.items():
@@ -125,9 +234,67 @@ class MetricsRegistry:
                 out.setdefault(scope, {})[name + ".count"] = t.count
                 out.setdefault(scope, {})[name + ".total_s"] = round(t.total_s, 6)
                 out.setdefault(scope, {})[name + ".max_s"] = round(t.max_s, 6)
+            for (scope, name), h in self._histograms.items():
+                for q in (0.5, 0.95, 0.99):
+                    out.setdefault(scope, {})[
+                        f"{name}.p{round(q * 100):d}"] = round(
+                            h.percentile(q), 6)
+                if (scope, name) not in self._timers:
+                    out.setdefault(scope, {})[name + ".count"] = h.count
+                    out.setdefault(scope, {})[name + ".sum"] = round(h.total, 6)
             for (scope, name), v in self._gauges.items():
                 out.setdefault(scope, {})[name] = v
         return out
+
+    # -- prometheus exposition (text format 0.0.4) --------------------------
+
+    def to_prometheus(self, prefix: str = "cadence") -> str:
+        """Render every series in prometheus text format. Scope stays a
+        label (the tally-tagged-scope shape), the metric name is
+        sanitized into the prometheus grammar: counters get `_total`,
+        histograms emit `_bucket`/`_sum`/`_count` with `le` labels."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = {k: h for k, h in self._histograms.items()}
+
+        def metric_name(name: str) -> str:
+            return prefix + "_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+        def fmt(value: float) -> str:
+            return str(int(value)) if float(value).is_integer() else str(value)
+
+        lines: List[str] = []
+        typed: set = set()
+
+        def header(mname: str, kind: str) -> None:
+            if mname not in typed:
+                typed.add(mname)
+                lines.append(f"# TYPE {mname} {kind}")
+
+        def by_family(items):
+            # all samples of one metric family must be contiguous
+            # (exposition-format requirement), so sort name-first
+            return sorted(items, key=lambda kv: (kv[0][1], kv[0][0]))
+
+        for (scope, name), v in by_family(counters.items()):
+            mname = metric_name(name) + "_total"
+            header(mname, "counter")
+            lines.append(f'{mname}{{scope="{scope}"}} {v}')
+        for (scope, name), v in by_family(gauges.items()):
+            mname = metric_name(name)
+            header(mname, "gauge")
+            lines.append(f'{mname}{{scope="{scope}"}} {fmt(v)}')
+        for (scope, name), hist in by_family(histograms.items()):
+            mname = metric_name(name)
+            header(mname, "histogram")
+            for le, cum in hist.cumulative():
+                lines.append(
+                    f'{mname}_bucket{{scope="{scope}",le="{le}"}} {cum}')
+            lines.append(
+                f'{mname}_sum{{scope="{scope}"}} {fmt(round(hist.total, 9))}')
+            lines.append(f'{mname}_count{{scope="{scope}"}} {hist.count}')
+        return "\n".join(lines) + ("\n" if lines else "")
 
 
 class Scope:

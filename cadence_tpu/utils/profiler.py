@@ -1,0 +1,75 @@
+"""Replay profiler: per-kernel-launch leg timing into metric histograms.
+
+BENCH numbers report one end-to-end rate; regressions can't be localized
+without decomposing a launch into its host legs. Every instrumented
+replay path (engine/tpu_engine.py, engine/rebuild.py, native/feeder.py,
+ops/replay.replay_corpus) wraps its phases in a ReplayProfiler:
+
+  pack     — host encode/pack of the event corpus
+  h2d      — host→device transfer dispatch (+ bytes moved, M_H2D_BYTES)
+  kernel   — device replay compute, measured to block_until_ready
+  readback — device→host pull of payload rows / CRCs / errors
+
+Legs land as histograms under the component's scope (SCOPE_TPU_REPLAY by
+default, SCOPE_REBUILD for the rebuilder), so `/metrics` scrapes, the
+admin snapshot, and bench.py can all diff the legs across rounds.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from . import metrics as m
+
+#: the leg metric names, in pipeline order
+LEGS = (m.M_PROFILE_PACK, m.M_PROFILE_H2D, m.M_PROFILE_KERNEL,
+        m.M_PROFILE_READBACK)
+
+
+class ReplayProfiler:
+    """Cheap handle over a registry: construct per launch site, record
+    legs; summary() aggregates whatever the registry has accumulated."""
+
+    def __init__(self, registry: Optional[m.MetricsRegistry] = None,
+                 scope: str = m.SCOPE_TPU_REPLAY) -> None:
+        self.registry = registry if registry is not None else m.DEFAULT_REGISTRY
+        self.scope = scope
+
+    @contextmanager
+    def leg(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.registry.observe(self.scope, name,
+                                  time.perf_counter() - t0)
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.registry.observe(self.scope, name, seconds)
+
+    def h2d(self, nbytes: int) -> None:
+        """One host→device transfer of `nbytes` (count + size histogram)."""
+        self.registry.inc(self.scope, m.M_H2D_BYTES, int(nbytes))
+        self.registry.observe(self.scope, m.M_H2D_BYTES + "-per-transfer",
+                              float(nbytes), buckets=m.BYTE_BUCKETS)
+
+    def summary(self) -> Dict[str, object]:
+        """Leg breakdown for reports (the bench JSON / `admin profile`)."""
+        out: Dict[str, object] = {
+            "scope": self.scope,
+            "kernel_launches": self.registry.counter(
+                self.scope, m.M_KERNEL_LAUNCHES),
+            "h2d_bytes": self.registry.counter(self.scope, m.M_H2D_BYTES),
+        }
+        for leg in LEGS:
+            hist = self.registry.histogram(self.scope, leg)
+            if hist.count == 0:
+                continue
+            out[leg] = {
+                "count": hist.count,
+                "total_s": round(hist.total, 6),
+                "p50_s": round(hist.percentile(0.5), 6),
+                "p99_s": round(hist.percentile(0.99), 6),
+            }
+        return out

@@ -1,0 +1,91 @@
+"""HTTP scrape surface: /metrics (prometheus), /health, /traces.
+
+Reference: the Go server mounts tally's prometheus reporter plus a
+health endpoint on every role's HTTP port. Here one tiny stdlib HTTP
+server serves the same three probes over any MetricsRegistry/Tracer
+pair; rpc/server.ServiceHost mounts it next to the wire port, and
+Onebox.scrape_server() exposes the in-process cluster the same way.
+
+  GET /metrics  → text/plain prometheus exposition (registry.to_prometheus)
+  GET /health   → application/json from the owner's health_fn
+  GET /traces   → application/json finished spans grouped by trace_id
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+
+class ObservabilityHTTPServer:
+    """A started-on-demand scrape server over one registry (+ optional
+    tracer). Bind port 0 for an ephemeral port (tests); `port` carries
+    the bound value either way."""
+
+    def __init__(self, registry, health_fn: Optional[Callable[[], Dict]] = None,
+                 tracer=None,
+                 address: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
+        self.registry = registry
+        self.health_fn = health_fn
+        self.tracer = tracer
+        owner = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:
+                pass  # scrape traffic must not spam the host's stderr
+
+            def _reply(self, status: int, content_type: str,
+                       body: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._reply(200,
+                                    "text/plain; version=0.0.4; charset=utf-8",
+                                    owner.registry.to_prometheus().encode())
+                    elif path == "/health":
+                        health = (owner.health_fn()
+                                  if owner.health_fn else {"status": "ok"})
+                        self._reply(200, "application/json",
+                                    json.dumps(health, default=str).encode())
+                    elif path == "/traces" and owner.tracer is not None:
+                        traces = {
+                            tid: [s.to_dict() for s in spans]
+                            for tid, spans in owner.tracer.traces().items()}
+                        self._reply(200, "application/json",
+                                    json.dumps(traces, default=str).encode())
+                    else:
+                        self._reply(404, "text/plain", b"not found\n")
+                except Exception as exc:
+                    try:
+                        self._reply(500, "text/plain",
+                                    f"{type(exc).__name__}: {exc}\n".encode())
+                    except Exception:
+                        pass  # peer went away mid-reply
+
+        self._httpd = ThreadingHTTPServer(address, _Handler)
+        self._httpd.daemon_threads = True
+        self.port: int = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObservabilityHTTPServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        # shutdown() waits on an event only serve_forever() sets — calling
+        # it on a never-started server would deadlock, so gate on the
+        # thread (stop() must be safe from any cleanup path)
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread = None
+        self._httpd.server_close()

@@ -56,18 +56,20 @@ up() {
       spawn "standby-host-$i" python -m cadence_tpu.rpc.server \
           --name "standby-host-$i" --port "725$((i+1))" \
           --store 127.0.0.1:7250 --num-shards 16 \
-          --cluster-name standby --peer primary=127.0.0.1:7240
+          --cluster-name standby --peer primary=127.0.0.1:7240 \
+          --http-port "825$((i+1))"
     done
   fi
   for i in 0 1; do
     spawn "host-$i" python -m cadence_tpu.rpc.server \
         --name "host-$i" --port "724$((i+1))" \
         --store 127.0.0.1:7240 --num-shards 16 \
-        --cluster-name primary ${peer_args[@]+"${peer_args[@]}"}
+        --cluster-name primary ${peer_args[@]+"${peer_args[@]}"} \
+        --http-port "824$((i+1))"
   done
   wait_port 7241
-  echo "cluster up: store 127.0.0.1:7240, frontends 7241/7242" \
-       "(logs in $RUN_DIR)"
+  echo "cluster up: store 127.0.0.1:7240, frontends 7241/7242," \
+       "scrape http://127.0.0.1:8241/metrics (logs in $RUN_DIR)"
 }
 
 down() {

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Observability smoke: boot a onebox, run one workflow, device-replay it,
+# scrape /metrics + /health, and FAIL on missing required metric names
+# (the assertions live in tests/test_observability.py::TestScrapeSurface).
+#
+# Usage: deploy/smoke_observability.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
+    -m smoke -q "$@"

@@ -28,3 +28,17 @@ jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_default_observability():
+    """DEFAULT_REGISTRY / DEFAULT_TRACER are process-global fallbacks that
+    components constructed without explicit wiring share; reset them IN
+    PLACE (components hold them by reference) before every test so one
+    test's counters and spans never leak into another's assertions."""
+    from cadence_tpu.utils import metrics, tracing
+    metrics.DEFAULT_REGISTRY.reset()
+    tracing.DEFAULT_TRACER.reset()
+    yield
