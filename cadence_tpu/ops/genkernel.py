@@ -407,8 +407,14 @@ def _sharded_fn(mesh, local: int, total_events: int,
         first = offset[0]
         # mark the constant-built initial carries as varying across the
         # mesh (each shard's trajectory differs), or scan/cond typing
-        # rejects the mix of replicated carries with shard-varying lanes
+        # rejects the mix of replicated carries with shard-varying lanes.
+        # Pre-typeof JAX (<0.6) has no varying-manual-axes typing at all:
+        # no lifting is needed (or possible — pvary/pcast don't exist),
+        # so the tree passes through untouched there.
         def varying(tree):
+            if not hasattr(jax, "typeof"):
+                return tree
+
             def pv(x):
                 # only lift replicated leaves; some (built from the traced
                 # offset) are already shard-varying

@@ -1,0 +1,170 @@
+"""Wire-level chaos: drop, delay, and sever connections mid-stream.
+
+Reference: the store tier already has `engine/faults.py`
+(persistenceErrorInjectionClients.go analog — errors injected BEFORE the
+target method runs). This module is the same discipline one layer down,
+at the TRANSPORT: the client-side request path of every wire call can be
+
+- DELAYED  (a jittered sleep before the frame is written — latency
+  injection; bounded, so deadline budgets absorb it),
+- DROPPED  (the connection is closed before ANY request byte is sent —
+  the classic connect-then-die peer),
+- SEVERED  (a PARTIAL frame is written, then the socket is torn down —
+  the peer sees a mid-stream FIN and discards the torn frame).
+
+All three are injected on the REQUEST leg only, before the server can
+have dispatched anything: a torn frame never unpickles (rpc/wire.py
+`_read_exact` raises "peer closed mid-frame" and the handler drops the
+connection), so an injected fault ALWAYS means "nothing was applied".
+That is the property that makes `ChaosError` universally retryable and
+lets the chaos soak demand byte-identical mutable-state checksums
+against a fault-free run — at-least-once delivery with zero divergence.
+
+Configuration (cross-process, so subprocess clusters inherit it):
+
+    CADENCE_TPU_CHAOS="drop=0.05,sever=0.03,delay=0.1,delay_ms=10,seed=7"
+
+or programmatically via `install(WireChaos(...))` / `uninstall()`; the
+same spec string can ride dynamicconfig (KEY_WIRE_CHAOS) into a
+ServiceHost. Seeded RNG keeps runs reproducible.
+"""
+from __future__ import annotations
+
+import os
+import random
+import socket
+import threading
+import time
+from typing import Optional
+
+
+class ChaosError(ConnectionError):
+    """An injected transport fault. Guaranteed nothing-was-applied (the
+    request never reached a dispatchable frame), so every client tier may
+    retry it regardless of the op's idempotency."""
+
+
+class WireChaos:
+    """Seeded fault decider + injector for the client request path.
+
+    Probabilities are per-call and independent; `delay_ms` is the MAX
+    latency injected (actual delay is uniform in [0, delay_ms])."""
+
+    def __init__(self, drop: float = 0.0, sever: float = 0.0,
+                 delay: float = 0.0, delay_ms: float = 10.0,
+                 seed: int = 0) -> None:
+        self.drop = drop
+        self.sever = sever
+        self.delay = delay
+        self.delay_ms = delay_ms
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected_drops = 0
+        self.injected_severs = 0
+        self.injected_delays = 0
+
+    def _roll(self) -> tuple:
+        with self._lock:
+            return (self._rng.random(), self._rng.random(),
+                    self._rng.random(), self._rng.random())
+
+    def before_send(self, sock: socket.socket,
+                    header: bytes, body: bytes) -> None:
+        """Called by the wire just before a request frame is written.
+        Raises ChaosError (after closing `sock`) for drop/sever; sleeps
+        for delay; returns normally to let the real send proceed."""
+        r_delay, r_jitter, r_drop, r_sever = self._roll()
+        if self.delay > 0 and r_delay < self.delay:
+            with self._lock:
+                self.injected_delays += 1
+            time.sleep(r_jitter * self.delay_ms / 1000.0)
+        if self.drop > 0 and r_drop < self.drop:
+            with self._lock:
+                self.injected_drops += 1
+            _teardown(sock)
+            raise ChaosError("chaos: connection dropped before send")
+        if self.sever > 0 and r_sever < self.sever:
+            with self._lock:
+                self.injected_severs += 1
+            # mid-stream sever: leak a partial frame so the peer's
+            # _read_exact sees a torn body, then hard-close
+            try:
+                sock.sendall(header + body[: max(1, len(body) // 2)])
+            except OSError:
+                pass
+            _teardown(sock)
+            raise ChaosError("chaos: connection severed mid-frame")
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {"drops": self.injected_drops,
+                    "severs": self.injected_severs,
+                    "delays": self.injected_delays}
+
+
+def _teardown(sock: socket.socket) -> None:
+    """RST-ish teardown: no graceful shutdown handshake."""
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# -- process-wide installation ----------------------------------------------
+
+_ACTIVE: Optional[WireChaos] = None
+_ENV = "CADENCE_TPU_CHAOS"
+_LOADED_ENV = False
+_LOAD_LOCK = threading.Lock()
+
+
+def parse_kv_spec(spec: str, casts: dict) -> dict:
+    """Shared "k=v,k=v" spec parser for the fault-injection env vars
+    (CADENCE_TPU_CHAOS here, CADENCE_TPU_STORE_FAULTS in storeserver).
+    Unknown keys raise — a typo'd spec silently doing nothing is worse
+    than failing loudly at boot."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        cast = casts.get(key)
+        if cast is None:
+            raise ValueError(f"unknown knob {key!r} in {spec!r}")
+        out[key] = cast(value.strip())
+    return out
+
+
+def parse_spec(spec: str) -> WireChaos:
+    """"drop=0.05,sever=0.03,delay=0.1,delay_ms=10,seed=7" → WireChaos."""
+    return WireChaos(**parse_kv_spec(
+        spec, {"drop": float, "sever": float, "delay": float,
+               "delay_ms": float, "seed": int}))
+
+
+def install(chaos: Optional[WireChaos]) -> None:
+    """Programmatic installation (tests); None uninstalls."""
+    global _ACTIVE, _LOADED_ENV
+    _ACTIVE = chaos
+    _LOADED_ENV = True  # explicit choice overrides the env default
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def active() -> Optional[WireChaos]:
+    """The process's chaos injector, lazily loaded from CADENCE_TPU_CHAOS
+    on first use (subprocess cluster hosts pick it up with zero plumbing).
+    Fast path: one global read when chaos was never configured."""
+    global _ACTIVE, _LOADED_ENV
+    if not _LOADED_ENV:
+        with _LOAD_LOCK:
+            if not _LOADED_ENV:
+                spec = os.environ.get(_ENV, "")
+                if spec:
+                    _ACTIVE = parse_spec(spec)
+                _LOADED_ENV = True
+    return _ACTIVE

@@ -40,7 +40,19 @@ class FrontendClient:
     Retries ShardOwnershipLostError with backoff — the retryable-client
     tier (client/frontend wrappers): shard movement mid-request is a
     ROUTINE transient in a live cluster (steal, flap, re-acquire), and the
-    fence guarantees a retry lands on a valid owner or fails honestly."""
+    fence guarantees a retry lands on a valid owner or fails honestly.
+    ServiceBusy (a breaker shedding somewhere downstream) and
+    TransientStoreError (injected pre-apply, never partially committed)
+    are retried the same way; breaker-open on THIS client's own target
+    surfaces as a typed ServiceBusy once retries exhaust, so callers
+    degrade instead of queueing behind a dead host.
+
+    Caveat (same as the pre-existing ConnectionRefusedError retry): a
+    ServiceBusy can fire AFTER a mutation partially applied on the
+    serving host (create committed, then a forward hit an open breaker),
+    so a retried start may surface WorkflowAlreadyStartedError — callers
+    treat that as success (the run is fully usable with history-first
+    ordering; see tests/test_faults.py)."""
 
     RETRIES = 8
     BACKOFF_S = 0.25
@@ -56,7 +68,9 @@ class FrontendClient:
 
         def invoke(*args, **kwargs):
             from ..engine.controller import ShardNotOwnedError
+            from ..engine.faults import TransientStoreError
             from ..engine.persistence import ShardOwnershipLostError
+            from ..utils.circuitbreaker import CircuitOpenError, ServiceBusy
 
             # ConnectionRefusedError: an outbound hop inside the serving
             # host hit a dead peer before the ring noticed — nothing was
@@ -66,8 +80,14 @@ class FrontendClient:
                 try:
                     return pool.call(("frontend", method, args, kwargs))
                 except (ShardOwnershipLostError, ShardNotOwnedError,
-                        ConnectionRefusedError) as exc:
+                        ConnectionRefusedError, ServiceBusy,
+                        TransientStoreError) as exc:
                     last = exc
+                    time.sleep(self.BACKOFF_S * (attempt + 1))
+                except CircuitOpenError as exc:
+                    # this client's own breaker shed the call: back off for
+                    # the breaker's reset window, then probe again
+                    last = ServiceBusy(str(exc))
                     time.sleep(self.BACKOFF_S * (attempt + 1))
             raise last
 
@@ -299,13 +319,16 @@ def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
 def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
            hb_interval: float = 0.15, ttl: float = 3.0,
            cluster_name: str = "primary", store_port: int = 0,
-           peer_specs=()) -> Cluster:
+           peer_specs=(), env_extra=None) -> Cluster:
     """Spawn the store server + `num_hosts` service hosts as OS processes.
     The TTL must comfortably exceed worst-case heartbeat jitter (a
     GIL-starved beat thread on a loaded host): a too-tight TTL makes the
     failure detector flap, and every flap is a spurious steal — safe
-    (fencing holds) but churny. Test-sized here; production stretches both."""
+    (fencing holds) but churny. Test-sized here; production stretches both.
+    `env_extra` lands in every spawned process — the chaos soak sets
+    CADENCE_TPU_CHAOS / CADENCE_TPU_STORE_FAULTS through it."""
     env = dict(os.environ)
+    env.update(env_extra or {})
     env.setdefault("JAX_PLATFORMS", "cpu")  # control-plane processes
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

@@ -36,8 +36,15 @@ from ..engine.history_engine import HistoryEngine
 from ..engine.matching import MatchingEngine
 from ..engine.membership import HashRing
 from ..engine.queues import QueueProcessors
+from ..utils import deadline as deadline_mod
 from ..utils import tracing
+from ..utils.circuitbreaker import (
+    BreakerRegistry,
+    CircuitOpenError,
+    ServiceBusy,
+)
 from ..utils.clock import RealTimeSource
+from ..utils.deadline import DeadlineExceeded
 from .client import RemoteEngine, RemoteMatching, RemoteStores
 from .wire import recv_frame, send_frame, verify_hello
 
@@ -63,7 +70,9 @@ class RoutedMatching:
         owner, address = self._host.tasklist_owner(task_list)
         if owner == self._host.name:
             return None
-        return RemoteMatching(address)
+        return RemoteMatching(address, metrics=self._host.metrics,
+                              breakers=self._host.breakers,
+                              retry_policy=self._host.retry_policy)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -158,7 +167,6 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         #: the address peers must DIAL to reach this host (loopback only
         #: works single-machine; containers advertise their service name)
         self.advertise_host = advertise_host
-        self.stores = RemoteStores(store_address)
         self.num_shards = num_shards
         self.hb_interval = hb_interval
         self.ttl = ttl
@@ -169,6 +177,36 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.clock = RealTimeSource()
         self.config = DynamicConfig()
         self.metrics = MetricsRegistry()
+        #: per-target circuit breakers shared by EVERY outbound client this
+        #: host creates (store, peer engines, matching forwards) — breaker
+        #: state gauges land on this host's /metrics
+        from ..utils import dynamicconfig as dc
+        from .client import retry_policy_from_config
+        self.breakers = BreakerRegistry(
+            metrics=self.metrics,
+            failure_threshold=int(
+                self.config.get(dc.KEY_RPC_BREAKER_FAILURE_THRESHOLD)),
+            reset_timeout_s=float(
+                self.config.get(dc.KEY_RPC_BREAKER_RESET_TIMEOUT_S)))
+        self.retry_policy = retry_policy_from_config(self.config)
+        self.stores = RemoteStores(store_address, metrics=self.metrics,
+                                   breakers=self.breakers,
+                                   retry_policy=self.retry_policy)
+        # pre-register the resilience counters so /metrics always exposes
+        # the names (scraped as zero before the first retry/shed/expiry)
+        for scope_name, metric in (("rpc.client", "retries"),
+                                   ("rpc.client", "breaker-rejected"),
+                                   ("rpc.client", "deadline-expired"),
+                                   ("rpc.server",
+                                    "deadline-expired-rejections"),
+                                   ("rpc.circuitbreaker", "transitions")):
+            self.metrics.inc(scope_name, metric, 0)
+        # wire chaos can also arrive via dynamicconfig (the env var is the
+        # subprocess path; an operator override here wins)
+        chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)
+        if chaos_spec:
+            from . import chaos as chaos_mod
+            chaos_mod.install(chaos_mod.parse_spec(chaos_spec))
         self.tracer = tracing.DEFAULT_TRACER
         #: HTTP scrape surface (/metrics, /health, /traces): bound in
         #: __init__ so the port is known before start(); 0 = ephemeral
@@ -250,7 +288,10 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             CrossClusterPublisher(self.stores))
 
         for peer_name, store_addr in self.peers.items():
-            peer = RemoteCluster(store_addr, peer_ttl=self.ttl)
+            peer = RemoteCluster(store_addr, peer_ttl=self.ttl,
+                                 metrics=self.metrics,
+                                 breakers=self.breakers,
+                                 retry_policy=self.retry_policy)
 
             def read_peer_history(domain_id, workflow_id, run_id,
                                   from_id, to_id, _peer=peer):
@@ -340,7 +381,9 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             address = self._peer_addresses.get(owner)
             if address is None:
                 raise
-            return RemoteEngine(address, workflow_id)
+            return RemoteEngine(address, workflow_id, metrics=self.metrics,
+                                breakers=self.breakers,
+                                retry_policy=self.retry_policy)
 
     def tasklist_owner(self, task_list: str) -> Tuple[str, Tuple[str, int]]:
         owner = self.ring.lookup(f"tasklist-{task_list}")
@@ -441,17 +484,34 @@ class _Handler(socketserver.BaseRequestHandler):
             except (OSError, ConnectionError):
                 return
             # a traced envelope parents this request's span on the caller's
-            # span; untraced traffic (pump loops, heartbeats) stays span-free
+            # span; untraced traffic (pump loops, heartbeats) stays span-free;
+            # the caller's DEADLINE budget rides the same carrier
+            remote_deadline = deadline_mod.peek(req)
             remote_ctx, req = tracing.extract(req)
             matched_poll = None  # (task, task_type) needing dead-socket requeue
             try:
                 op = req[0] if isinstance(req, tuple) and req else "?"
+                if remote_deadline is not None and remote_deadline.expired():
+                    # the caller has already given up: reject BEFORE burning
+                    # a dispatch (store transaction, kernel launch)
+                    server.metrics.inc("rpc.server",
+                                       "deadline-expired-rejections")
+                    raise DeadlineExceeded(
+                        f"rpc.{op} arrived with its deadline expired")
                 span_cm = (server.tracer.start_span(f"rpc.{op}",
                                                     child_of=remote_ctx)
                            if remote_ctx is not None else nullcontext())
-                with span_cm:
+                # bind the remaining budget for the dispatch, so every
+                # outbound hop this handler makes (store writes, peer
+                # engines) inherits the shrinking deadline
+                with span_cm, deadline_mod.bind(remote_deadline):
                     result, matched_poll = self._dispatch(server, req)
                 response = ("ok", result)
+            except CircuitOpenError as exc:
+                # an outbound dependency of this host is being shed: the
+                # caller sees a typed busy signal, not a mystery
+                # ConnectionError (degrade, don't queue behind a dead host)
+                response = ("err", ServiceBusy(str(exc)))
             except BaseException as exc:
                 response = ("err", exc)
             try:

@@ -29,7 +29,9 @@ from contextlib import nullcontext
 from typing import Dict, Tuple
 
 from ..engine.persistence import Stores
+from ..utils import deadline as deadline_mod
 from ..utils import tracing
+from ..utils.deadline import DeadlineExceeded
 from .wire import recv_frame, send_frame, verify_hello
 
 
@@ -75,14 +77,22 @@ class _Handler(socketserver.BaseRequestHandler):
             except (OSError, ConnectionError):
                 return
             # engine transactions traced at a service host propagate here
-            # too, so store round-trips appear inside the same trace
+            # too, so store round-trips appear inside the same trace; the
+            # caller's deadline budget rides the same carrier
+            remote_deadline = deadline_mod.peek(req)
             remote_ctx, req = tracing.extract(req)
             try:
                 op = req[0]
+                if remote_deadline is not None and remote_deadline.expired():
+                    from ..utils.metrics import DEFAULT_REGISTRY
+                    DEFAULT_REGISTRY.inc("rpc.server",
+                                         "deadline-expired-rejections")
+                    raise DeadlineExceeded(
+                        f"store rpc.{op} arrived with its deadline expired")
                 span_cm = (tracing.DEFAULT_TRACER.start_span(
                                f"rpc.{op}", child_of=remote_ctx)
                            if remote_ctx is not None else nullcontext())
-                with span_cm:
+                with span_cm, deadline_mod.bind(remote_deadline):
                     result = self._dispatch(server, req)
                 response = ("ok", result)
             except BaseException as exc:  # service errors cross the wire
@@ -116,10 +126,32 @@ class _Handler(socketserver.BaseRequestHandler):
         raise ValueError(f"unknown op {op!r}")
 
 
-def serve(port: int, wal: str = "", host: str = "127.0.0.1") -> None:
-    if wal:
-        import os
+#: env spec for seeded store-fault injection in a store-server PROCESS
+#: (the subprocess analog of calling engine/faults.inject_faults in-proc):
+#:   CADENCE_TPU_STORE_FAULTS="rate=0.05,seed=7"
+STORE_FAULTS_ENV = "CADENCE_TPU_STORE_FAULTS"
 
+
+def _parse_fault_spec(spec: str):
+    """"rate=0.05,seed=7[,writes_only=0]" → FaultInjector. Injected
+    errors raise BEFORE the store method runs (engine/faults.py), so a
+    caller retry is always safe — the property the chaos soak leans on."""
+    from ..engine.faults import FaultInjector
+    from .chaos import parse_kv_spec
+
+    def to_bool(value: str) -> bool:
+        return value.lower() not in ("0", "false", "no", "off", "")
+
+    kwargs = parse_kv_spec(
+        spec, {"rate": float, "seed": int, "writes_only": to_bool})
+    return FaultInjector(**kwargs)
+
+
+def serve(port: int, wal: str = "", host: str = "127.0.0.1",
+          fault_spec: str = "") -> None:
+    import os
+
+    if wal:
         from ..engine.durability import open_durable_stores, recover_stores
         if os.path.exists(wal):
             stores, _report = recover_stores(wal, verify_on_device=False,
@@ -128,6 +160,10 @@ def serve(port: int, wal: str = "", host: str = "127.0.0.1") -> None:
             stores = open_durable_stores(wal)
     else:
         stores = Stores()
+    fault_spec = fault_spec or os.environ.get(STORE_FAULTS_ENV, "")
+    if fault_spec:
+        from ..engine.faults import inject_faults
+        inject_faults(stores, _parse_fault_spec(fault_spec))
     server = StoreServer((host, port), stores)
     server.serve_forever()
 
@@ -139,8 +175,12 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 in containers; the HMAC "
                         "connection preamble still gates every peer)")
+    p.add_argument("--fault-spec", default="",
+                   help="seeded store-fault injection, e.g. "
+                        "'rate=0.05,seed=7' (CADENCE_TPU_STORE_FAULTS "
+                        "env equivalent; chaos soak harness)")
     args = p.parse_args(argv)
-    serve(args.port, args.wal, host=args.host)
+    serve(args.port, args.wal, host=args.host, fault_spec=args.fault_spec)
     return 0
 
 

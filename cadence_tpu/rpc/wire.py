@@ -23,14 +23,38 @@ import socket
 import struct
 from typing import Any, Optional, Tuple
 
+from ..utils import deadline as deadline_mod
 from ..utils import tracing
+from ..utils.deadline import DeadlineExceeded
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
 
+#: per-hop ceiling when no caller deadline is bound (the old fixed value,
+#: now only an upper bound — live deadlines shrink it per call)
+DEFAULT_TIMEOUT_S = 30.0
+#: floor for derived socket timeouts: a nearly-expired budget still gets
+#: a sliver of wire time instead of a zero/negative timeout
+MIN_TIMEOUT_S = 0.001
+
 
 class WireError(ConnectionError):
     """Framing violation or truncated peer stream."""
+
+
+def effective_timeout(base: float = DEFAULT_TIMEOUT_S) -> float:
+    """Socket timeout for the next hop: the caller's remaining deadline
+    budget when one is bound (clamped to [MIN, base]), else `base`.
+    Raises DeadlineExceeded instead of dialing when the budget is gone —
+    the cheapest possible rejection."""
+    current = deadline_mod.current()
+    if current is None:
+        return base
+    remaining = current.remaining()
+    if remaining <= 0:
+        raise DeadlineExceeded(
+            f"deadline expired {-remaining:.3f}s before the call")
+    return max(MIN_TIMEOUT_S, min(base, remaining))
 
 
 # -- connection authentication ---------------------------------------------
@@ -92,11 +116,56 @@ def verify_hello(sock: socket.socket) -> None:
         raise WireError("unauthenticated peer (bad cluster secret)")
 
 
-def send_frame(sock: socket.socket, obj: Any) -> None:
+def _encode_frame(obj: Any) -> Tuple[bytes, bytes]:
     body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     if len(body) > MAX_FRAME:
         raise WireError(f"frame {len(body)}B exceeds {MAX_FRAME}B")
-    sock.sendall(_LEN.pack(len(body)) + body)
+    return _LEN.pack(len(body)), body
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    header, body = _encode_frame(obj)
+    sock.sendall(header + body)
+
+
+def send_request_frame(sock: socket.socket, obj: Any) -> None:
+    """The CLIENT request leg of send_frame: the chaos injector (when
+    installed) may drop, delay, or sever here — before the server can
+    have dispatched anything, so injected faults are always retryable.
+    Server RESPONSE sends stay on plain send_frame: a chaos'd response
+    would lose applied work and break at-least-once semantics.
+
+    Encode failures (oversized frame, unpicklable argument) are tagged
+    `_wire_local`: they happen before any byte reaches the peer, so they
+    are neither evidence against the target (breakers must not charge
+    them) nor worth a resend of the identical payload."""
+    from . import chaos as chaos_mod
+    try:
+        header, body = _encode_frame(obj)
+    except BaseException as exc:
+        try:
+            exc._wire_local = True
+        except Exception:
+            pass
+        raise
+    chaos = chaos_mod.active()
+    if chaos is not None:
+        chaos.before_send(sock, header, body)
+    sock.sendall(header + body)
+
+
+def _mark_relayed(exc: BaseException) -> BaseException:
+    """Tag an exception that arrived as an ("err", exc) RESPONSE: the
+    peer ANSWERED — the failure (possibly ConnectionError-shaped, from
+    the peer's own outbound hop) is not evidence against this transport,
+    and client breakers must not charge it (rpc/client._Pool reads the
+    tag). Best-effort: exotic exception types without a __dict__ simply
+    go untagged."""
+    try:
+        exc._wire_relayed = True
+    except Exception:
+        pass
+    return exc
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -123,37 +192,50 @@ def call(address: Tuple[str, int], request: Any, timeout: float = 30.0) -> Any:
     """One-shot request/response over a fresh connection. The response is
     ("ok", value) or ("err", exception) — errors re-raise at the caller,
     carrying the service-level type (ShardOwnershipLostError & co) across
-    the process boundary."""
+    the process boundary. An active caller deadline rides the envelope
+    and shrinks the socket timeout."""
+    timeout = effective_timeout(timeout)
     with socket.create_connection(address, timeout=timeout) as sock:
         send_hello(sock)
-        send_frame(sock, tracing.inject(request))
+        send_request_frame(sock, deadline_mod.inject(tracing.inject(request)))
         kind, payload = recv_frame(sock)
     if kind == "err":
-        raise payload
+        raise _mark_relayed(payload)
     return payload
 
 
 class Connection:
     """A pooled client connection (one in-flight request at a time)."""
 
-    def __init__(self, address: Tuple[str, int], timeout: float = 30.0) -> None:
+    def __init__(self, address: Tuple[str, int],
+                 timeout: float = DEFAULT_TIMEOUT_S) -> None:
         self.address = address
         self.timeout = timeout
         self._sock: socket.socket | None = None
 
-    def _ensure(self) -> socket.socket:
+    def _ensure(self, timeout: float) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self.address,
-                                                  timeout=self.timeout)
+                                                  timeout=timeout)
             send_hello(self._sock)
+        else:
+            # pooled socket: re-derive the timeout from THIS call's
+            # remaining budget, not whatever the opening call had left
+            self._sock.settimeout(timeout)
         return self._sock
 
     def call(self, request: Any) -> Any:
         for attempt in (0, 1):
-            sock = self._ensure()
+            # derived per attempt: send-retry time counts against the budget
+            timeout = effective_timeout(self.timeout)
+            sock = self._ensure(timeout)
             try:
-                send_frame(sock, request)
-            except (OSError, WireError):
+                send_request_frame(sock, request)
+            except (OSError, WireError) as exc:
+                # a LOCAL encode failure is deterministic: reconnecting
+                # and re-encoding the same payload cannot help
+                if getattr(exc, "_wire_local", False):
+                    raise
                 # a SEND failure on a pooled socket is the peer-restarted-
                 # between-calls case (stale FIN): nothing of this request
                 # was processed, so one reconnect+resend is safe
@@ -172,7 +254,7 @@ class Connection:
                 self.close()
                 raise
             if kind == "err":
-                raise payload
+                raise _mark_relayed(payload)
             return payload
 
     def close(self) -> None:

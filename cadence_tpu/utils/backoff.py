@@ -4,6 +4,9 @@ Reference:
 - activity retry interval: service/history/execution/retry.go:31-80
   (getBackoffInterval — exponential with cap, total-attempt limit,
   expiration cut-off, non-retriable reasons);
+- client retry policies:   common/backoff/retrypolicy.go
+  (ExponentialRetryPolicy — exponential with jitter, expiration
+  interval, attempt cap; wrapped around every service client);
 - cron continuation:      common/backoff/cron.go:48
   (GetBackoffForNextSchedule — next standard-cron fire time at or after
   the close time, measured from the close time, rounded up to seconds).
@@ -15,6 +18,7 @@ robfig/cron.ParseStandard accepts minus macros and time zones.
 from __future__ import annotations
 
 import math
+import random
 from datetime import datetime, timedelta, timezone
 from typing import List, Optional, Sequence
 
@@ -59,6 +63,75 @@ def get_backoff_interval(now_nanos: int, expiration_time_nanos: int,
     if failure_reason in non_retriable_errors:
         return NO_BACKOFF
     return backoff_nanos
+
+
+# ---------------------------------------------------------------------------
+# Client retry policy (common/backoff/retrypolicy.go semantics)
+# ---------------------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Exponential backoff with FULL jitter for cross-process clients.
+
+    `get_backoff_interval` above is the ACTIVITY retry policy (persisted,
+    deterministic, second-granularity); this is the in-memory CLIENT
+    policy the reference wraps every service/persistence client in
+    (common/backoff ExponentialRetryPolicy + ConcurrentRetrier):
+
+    - interval_i = init * coefficient^i, capped at max_interval;
+    - full jitter: the actual sleep is uniform in [0, interval_i]
+      (de-synchronizes retry storms across callers);
+    - stop when attempts exceed max_attempts, or when the NEXT sleep
+      would land past expiration_s of total elapsed time — the same
+      cut-off shape as get_backoff_interval's expiration check;
+    - NO_BACKOFF (-1) signals "stop retrying".
+
+    Seedable for reproducible tests; thread-safe (the RNG is the only
+    shared state and random.Random is internally locked).
+    """
+
+    def __init__(self, init_interval_s: float = 0.05,
+                 max_interval_s: float = 2.0,
+                 backoff_coefficient: float = 2.0,
+                 max_attempts: int = 5,
+                 expiration_s: float = 0.0,
+                 seed: Optional[int] = None) -> None:
+        if init_interval_s <= 0:
+            raise ValueError("init_interval_s must be > 0")
+        if backoff_coefficient < 1.0:
+            raise ValueError("backoff_coefficient must be >= 1.0")
+        self.init_interval_s = init_interval_s
+        self.max_interval_s = max_interval_s
+        self.backoff_coefficient = backoff_coefficient
+        self.max_attempts = max_attempts
+        self.expiration_s = expiration_s
+        self._rng = random.Random(seed)
+
+    def next_interval(self, attempt: int, elapsed_s: float) -> float:
+        """Jittered sleep before retry number `attempt` (0-based count of
+        FAILED tries so far), or NO_BACKOFF to stop.
+
+        max_attempts counts the initial try (retry.go:38 semantics): a
+        policy with max_attempts=3 sleeps at most twice."""
+        if self.max_attempts > 0 and attempt >= self.max_attempts - 1:
+            return NO_BACKOFF
+        try:
+            interval = (self.init_interval_s
+                        * math.pow(self.backoff_coefficient, float(attempt)))
+        except OverflowError:
+            interval = 0.0
+        if interval <= 0 or not math.isfinite(interval):
+            # pow overflow: fall to the cap, or stop if there is none
+            if self.max_interval_s > 0:
+                interval = self.max_interval_s
+            else:
+                return NO_BACKOFF
+        if self.max_interval_s > 0:
+            interval = min(interval, self.max_interval_s)
+        if (self.expiration_s > 0
+                and elapsed_s + interval > self.expiration_s):
+            return NO_BACKOFF
+        return self._rng.uniform(0.0, interval)
 
 
 # ---------------------------------------------------------------------------

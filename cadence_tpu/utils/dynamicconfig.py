@@ -50,6 +50,19 @@ KEY_HISTORY_SIZE_LIMIT_ERROR = "limit.historySizeError"
 # pagination: the default/maximum page any list-shaped API returns
 KEY_HISTORY_PAGE_SIZE = "limit.historyPageSize"
 KEY_VISIBILITY_PAGE_SIZE = "limit.visibilityPageSize"
+# rpc resilience tier (common/backoff retry policies + outbound breakers):
+# client retry policy for cross-process calls ...
+KEY_RPC_RETRY_MAX_ATTEMPTS = "rpc.retryMaxAttempts"
+KEY_RPC_RETRY_INIT_INTERVAL_MS = "rpc.retryInitIntervalMs"
+KEY_RPC_RETRY_MAX_INTERVAL_MS = "rpc.retryMaxIntervalMs"
+KEY_RPC_RETRY_EXPIRATION_S = "rpc.retryExpirationSeconds"
+# ... per-target circuit breakers ...
+KEY_RPC_BREAKER_FAILURE_THRESHOLD = "rpc.breakerFailureThreshold"
+KEY_RPC_BREAKER_RESET_TIMEOUT_S = "rpc.breakerResetSeconds"
+# ... and the wire chaos spec ("drop=0.05,sever=0.03,delay=0.1,seed=7";
+# empty = no chaos; the CADENCE_TPU_CHAOS env var is the cross-process
+# equivalent for subprocess clusters)
+KEY_WIRE_CHAOS = "rpc.wireChaos"
 
 _DEFAULTS: Dict[str, Any] = {
     KEY_MAX_ACTIVITIES: 16,
@@ -75,6 +88,13 @@ _DEFAULTS: Dict[str, Any] = {
     KEY_HISTORY_SIZE_LIMIT_ERROR: 200 * 1024 * 1024,
     KEY_HISTORY_PAGE_SIZE: 1000,
     KEY_VISIBILITY_PAGE_SIZE: 1000,
+    KEY_RPC_RETRY_MAX_ATTEMPTS: 6,
+    KEY_RPC_RETRY_INIT_INTERVAL_MS: 50,
+    KEY_RPC_RETRY_MAX_INTERVAL_MS: 1000,
+    KEY_RPC_RETRY_EXPIRATION_S: 30,
+    KEY_RPC_BREAKER_FAILURE_THRESHOLD: 5,
+    KEY_RPC_BREAKER_RESET_TIMEOUT_S: 5,
+    KEY_WIRE_CHAOS: "",
 }
 
 

@@ -38,7 +38,13 @@ def _fresh_default_observability():
     components constructed without explicit wiring share; reset them IN
     PLACE (components hold them by reference) before every test so one
     test's counters and spans never leak into another's assertions."""
-    from cadence_tpu.utils import metrics, tracing
+    from cadence_tpu.utils import circuitbreaker, metrics, tracing
     metrics.DEFAULT_REGISTRY.reset()
     tracing.DEFAULT_TRACER.reset()
+    # per-target breaker state is process-global the same way: a breaker
+    # opened by one test must not shed the next test's calls to a reused
+    # ephemeral port; chaos is per-process too, never leak an injector
+    circuitbreaker.DEFAULT_BREAKERS.reset()
+    from cadence_tpu.rpc import chaos
+    chaos.uninstall()
     yield

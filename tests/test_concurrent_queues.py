@@ -184,6 +184,22 @@ class TestConcurrentPump:
             deciders[wf] = ResilientEchoDecider(TL)
         sched = TaskScheduler(num_workers=4, max_attempts=8)
         poller = TaskPoller(box, DOMAIN, TL, deciders)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+
+        def open_workflows():
+            out = []
+            for i in range(8):
+                wf = f"wf-f-{i}"
+                try:
+                    run = box.stores.execution.get_current_run_id(
+                        domain_id, wf)
+                    ms = box.stores.execution.get_workflow(domain_id, wf, run)
+                    if ms.execution_info.close_status == CloseStatus.Nothing:
+                        out.append(wf)
+                except Exception:
+                    out.append(wf)
+            return out
+
         quiet = 0
         for _ in range(300):
             submitted = 0
@@ -216,14 +232,25 @@ class TestConcurrentPump:
             # rounds with advances in between
             if not progressed and box.matching.backlog() == 0:
                 quiet += 1
-                if quiet >= 3:
+                if quiet == 1 and open_workflows():
+                    # a start whose task insert faulted mid-transaction
+                    # leaves a runnable workflow with NO task anywhere (the
+                    # shard task queues are not durable state) — the task
+                    # refresher is the system's recovery for exactly that
+                    # (Onebox.refresh_all_tasks, the post-crash sweep);
+                    # regenerated tasks get pumped on the next rounds
+                    try:
+                        box.refresh_all_tasks()
+                    except TransientStoreError:
+                        pass
+                    quiet = 0
+                elif quiet >= 3:
                     break
             else:
                 quiet = 0
         sched.stop()
         assert injector.injected > 0
         assert sched.dead == []  # transient faults never kill a task
-        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
         for i in range(8):
             wf = f"wf-f-{i}"
             run = box.stores.execution.get_current_run_id(domain_id, wf)

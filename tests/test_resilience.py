@@ -1,0 +1,488 @@
+"""Resilient RPC tier: deadlines, retry policies, circuit breakers,
+stale-connection healing, and wire chaos.
+
+Reference: common/backoff (ExponentialRetryPolicy), hystrix-style
+outbound breakers, gRPC deadline propagation, and
+persistenceErrorInjectionClients.go-style injection moved down to the
+transport (rpc/chaos.py).
+"""
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.engine.persistence import Stores
+from cadence_tpu.rpc import chaos as chaos_mod
+from cadence_tpu.rpc.chaos import ChaosError, WireChaos
+from cadence_tpu.rpc.client import _Pool, _is_idempotent, RemoteStores
+from cadence_tpu.rpc.storeserver import StoreServer, _parse_fault_spec
+from cadence_tpu.rpc.wire import call as wire_call
+from cadence_tpu.utils import deadline as deadline_mod
+from cadence_tpu.utils.backoff import NO_BACKOFF, RetryPolicy
+from cadence_tpu.utils.circuitbreaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerRegistry,
+    CircuitBreaker,
+    CircuitOpenError,
+    ServiceBusy,
+)
+from cadence_tpu.utils.deadline import Deadline, DeadlineExceeded
+from cadence_tpu.utils.metrics import MetricsRegistry
+
+
+def start_store_server(port: int = 0, stores=None):
+    server = StoreServer(("127.0.0.1", port), stores or Stores())
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address[1]
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy (common/backoff retrypolicy.go edge cases)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_exponential_growth_capped_and_jittered(self):
+        policy = RetryPolicy(init_interval_s=0.1, max_interval_s=0.4,
+                             backoff_coefficient=2.0, max_attempts=0,
+                             seed=7)
+        # full jitter: every sample in [0, min(init*2^i, cap)]
+        for attempt, ceiling in ((0, 0.1), (1, 0.2), (2, 0.4), (9, 0.4)):
+            for _ in range(20):
+                s = policy.next_interval(attempt, 0.0)
+                assert 0.0 <= s <= ceiling
+
+    def test_max_attempts_counts_the_initial_try(self):
+        policy = RetryPolicy(max_attempts=3, seed=1)
+        assert policy.next_interval(0, 0.0) != NO_BACKOFF
+        assert policy.next_interval(1, 0.0) != NO_BACKOFF
+        # attempt index 2 would be the 4th try: stop (retry.go:38 shape)
+        assert policy.next_interval(2, 0.0) == NO_BACKOFF
+
+    def test_expiration_cuts_off(self):
+        policy = RetryPolicy(init_interval_s=0.5, max_interval_s=0.5,
+                             backoff_coefficient=1.0, max_attempts=0,
+                             expiration_s=2.0, seed=3)
+        assert policy.next_interval(0, 0.0) != NO_BACKOFF
+        # elapsed + next interval would land past expiration: stop
+        assert policy.next_interval(0, 1.9) == NO_BACKOFF
+        assert policy.next_interval(0, 5.0) == NO_BACKOFF
+
+    def test_coefficient_overflow_falls_to_cap(self):
+        policy = RetryPolicy(init_interval_s=1.0, max_interval_s=2.0,
+                             backoff_coefficient=1e308, max_attempts=0,
+                             seed=5)
+        # pow overflows to inf on a late attempt; the cap absorbs it
+        s = policy.next_interval(500, 0.0)
+        assert 0.0 <= s <= 2.0
+
+    def test_overflow_without_cap_stops(self):
+        policy = RetryPolicy(init_interval_s=1.0, max_interval_s=0.0,
+                             backoff_coefficient=1e308, max_attempts=0,
+                             seed=5)
+        assert policy.next_interval(500, 0.0) == NO_BACKOFF
+
+    def test_bad_parameters_rejected(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(init_interval_s=0.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(backoff_coefficient=0.5)
+
+    def test_non_retriable_classification(self):
+        """The _Pool classifier: chaos + injected store faults always
+        retry; transport faults retry only for idempotent requests; typed
+        service errors never retry."""
+        from cadence_tpu.engine.faults import TransientStoreError
+        from cadence_tpu.engine.persistence import ConditionFailedError
+
+        classify = _Pool._classify
+        assert classify(ChaosError("x"), False) is True
+        assert classify(TransientStoreError("x"), False) is True
+        assert classify(ConnectionResetError("x"), True) is True
+        assert classify(ConnectionResetError("x"), False) is False
+        assert classify(CircuitOpenError("x"), True) is False
+        assert classify(ConditionFailedError("x"), True) is False
+        assert classify(ValueError("x"), True) is False
+
+    def test_request_idempotency_classification(self):
+        assert _is_idempotent(("store", "execution", "get_workflow",
+                               (), {}))
+        assert _is_idempotent(("store", "queue", "size", (), {}))
+        assert not _is_idempotent(("store", "execution", "update_workflow",
+                                   (), {}))
+        assert _is_idempotent(("peers", 3.0))
+        assert _is_idempotent(("ping",))
+        assert _is_idempotent(("matching", "poll_for_decision_task",
+                               (), {}))
+        assert not _is_idempotent(("matching", "add_decision_task",
+                                   (), {}))
+        assert not _is_idempotent(("frontend", "signal_workflow_execution",
+                                   (), {}))
+        assert not _is_idempotent("garbage")
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_consecutive_failures_open(self):
+        b = CircuitBreaker(failure_threshold=3, reset_timeout_s=60.0)
+        for _ in range(2):
+            b.on_failure()
+        assert b.state() == CLOSED and b.allow()
+        b.on_failure()
+        assert b.state() == OPEN
+        assert not b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(failure_threshold=3)
+        b.on_failure()
+        b.on_failure()
+        b.on_success()
+        b.on_failure()
+        b.on_failure()
+        assert b.state() == CLOSED
+
+    def test_failure_rate_opens_over_min_throughput(self):
+        b = CircuitBreaker(failure_threshold=100, failure_rate=0.5,
+                           min_throughput=10)
+        # 5 failures / 9 calls: above rate but below throughput → closed
+        for _ in range(4):
+            b.on_success()
+        for _ in range(5):
+            b.on_failure()
+        assert b.state() == CLOSED
+        b.on_success()  # 10th call; next failure tips 6/11 > 0.5
+        b.on_failure()
+        assert b.state() == OPEN
+
+    def test_half_open_probe_success_closes(self):
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        b.on_failure()
+        assert b.state() == OPEN and not b.allow()
+        time.sleep(0.06)
+        assert b.allow()          # the single half-open probe
+        assert b.state() == HALF_OPEN
+        assert not b.allow()      # second concurrent probe is shed
+        b.on_success()
+        assert b.state() == CLOSED
+        assert b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        b.on_failure()
+        time.sleep(0.06)
+        assert b.allow()
+        b.on_failure()
+        assert b.state() == OPEN
+        assert not b.allow()      # reset clock restarted
+        time.sleep(0.06)
+        assert b.allow()          # probes again after another window
+
+    def test_abandoned_probe_releases_the_slot(self):
+        """A probe whose caller's DEADLINE expired produced no evidence:
+        the slot must free, or the breaker wedges HALF_OPEN forever and
+        sheds a recovered peer until process restart."""
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        b.on_failure()
+        time.sleep(0.06)
+        assert b.allow()              # this caller holds the probe
+        b.on_probe_abandoned()
+        assert b.state() == HALF_OPEN
+        assert b.allow()              # the next caller can still probe
+        b.on_success()
+        assert b.state() == CLOSED
+
+    def test_relayed_connection_error_not_charged_to_breaker(self):
+        """A ConnectionError the PEER reports as an op error (its own
+        outbound hop died) arrives as a well-formed ("err", exc) response:
+        the peer is healthy, so its breaker must stay closed and the
+        pooled socket must survive."""
+        stores_bundle = Stores()
+
+        def refuse(*args, **kwargs):
+            raise ConnectionRefusedError("downstream of the peer is dead")
+
+        stores_bundle.queue.enqueue = refuse
+        server, port = start_store_server(stores=stores_bundle)
+        try:
+            registry = MetricsRegistry()
+            breakers = BreakerRegistry(metrics=registry, failure_threshold=1)
+            remote = RemoteStores(("127.0.0.1", port), metrics=registry,
+                                  breakers=breakers)
+            with pytest.raises(ConnectionRefusedError):
+                remote.queue.enqueue("q", b"x")
+            assert breakers.for_target(("127.0.0.1", port)).state() == CLOSED
+            assert remote.ping() == "pong"
+        finally:
+            server.shutdown()
+
+    def test_local_encode_failure_not_charged_to_breaker(self, monkeypatch):
+        """A failure raised BEFORE any byte leaves this process (oversize
+        frame, unpicklable argument) says nothing about the peer: the
+        breaker stays closed and the healthy pooled socket survives."""
+        from cadence_tpu.rpc import wire
+
+        server, port = start_store_server()
+        try:
+            registry = MetricsRegistry()
+            breakers = BreakerRegistry(metrics=registry, failure_threshold=1)
+            remote = RemoteStores(("127.0.0.1", port), metrics=registry,
+                                  breakers=breakers)
+            assert remote.ping() == "pong"
+            monkeypatch.setattr(wire, "MAX_FRAME", 64)
+            with pytest.raises(wire.WireError):
+                remote.queue.enqueue("q", b"x" * 4096)
+            monkeypatch.setattr(wire, "MAX_FRAME", 256 * 1024 * 1024)
+            with pytest.raises(Exception):
+                remote.queue.enqueue("q", lambda: None)  # unpicklable
+            assert breakers.for_target(("127.0.0.1", port)).state() == CLOSED
+            assert remote.ping() == "pong"
+        finally:
+            server.shutdown()
+
+    def test_registry_emits_state_gauge_and_transitions(self):
+        registry = MetricsRegistry()
+        breakers = BreakerRegistry(metrics=registry, failure_threshold=1,
+                                   reset_timeout_s=60.0)
+        b = breakers.for_target(("10.0.0.1", 7000))
+        assert registry.gauge_value("rpc.circuitbreaker.10.0.0.1:7000",
+                                    "breaker-state") == float(CLOSED)
+        b.on_failure()
+        assert registry.gauge_value("rpc.circuitbreaker.10.0.0.1:7000",
+                                    "breaker-state") == float(OPEN)
+        assert registry.counter("rpc.circuitbreaker", "transitions") == 1
+        assert registry.counter("rpc.circuitbreaker", "opened") == 1
+        assert breakers.snapshot() == {"10.0.0.1:7000": "open"}
+
+    def test_pool_sheds_when_breaker_open(self):
+        registry = MetricsRegistry()
+        breakers = BreakerRegistry(metrics=registry, failure_threshold=1,
+                                   reset_timeout_s=60.0)
+        pool = _Pool(("127.0.0.1", 1), metrics=registry, breakers=breakers)
+        breakers.for_target(("127.0.0.1", 1)).on_failure()
+        t0 = time.perf_counter()
+        with pytest.raises(CircuitOpenError):
+            pool.call(("ping",))
+        assert time.perf_counter() - t0 < 0.1  # shed, not a connect timeout
+        assert registry.counter("rpc.client", "breaker-rejected") == 1
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation
+# ---------------------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_bind_and_current_nest(self):
+        assert deadline_mod.current() is None
+        with deadline_mod.bind(Deadline.after(5.0)) as outer:
+            assert deadline_mod.current() is outer
+            with deadline_mod.bind(Deadline.after(1.0)) as inner:
+                assert deadline_mod.current() is inner
+            assert deadline_mod.current() is outer
+        assert deadline_mod.current() is None
+        # bind(None) is a pass-through
+        with deadline_mod.bind(None):
+            assert deadline_mod.current() is None
+
+    def test_inject_peek_roundtrip(self):
+        with deadline_mod.bind(Deadline.after(5.0)):
+            wrapped = deadline_mod.inject(("ping",))
+        peeked = deadline_mod.peek(wrapped)
+        assert peeked is not None
+        assert 4.0 < peeked.remaining() <= 5.0
+        # coexists with a trace carrier on the same envelope
+        from cadence_tpu.utils import tracing
+        with tracing.DEFAULT_TRACER.start_span("op"):
+            with deadline_mod.bind(Deadline.after(5.0)):
+                wrapped = deadline_mod.inject(tracing.inject(("ping",)))
+        ctx, inner = tracing.extract(wrapped)
+        assert ctx is not None and inner == ("ping",)
+        assert deadline_mod.peek(wrapped) is not None
+        # pass-through without a bound deadline; tolerant peek
+        assert deadline_mod.inject(("ping",)) == ("ping",)
+        assert deadline_mod.peek(("ping",)) is None
+        assert deadline_mod.peek(("traced", {"deadline_s": "bogus"},
+                                  ("ping",))) is None
+
+    def test_expired_budget_fails_before_dialing(self):
+        # no listener needed: the call must not even attempt a connect
+        pool = _Pool(("127.0.0.1", 1))
+        with deadline_mod.bind(Deadline.after(-1.0)):
+            with pytest.raises(DeadlineExceeded):
+                pool.call(("ping",))
+
+    def test_server_rejects_expired_envelope(self):
+        server, port = start_store_server()
+        try:
+            # an honest call works
+            assert wire_call(("127.0.0.1", port), ("ping",)) == "pong"
+            # forge an envelope that arrives already expired
+            with pytest.raises(DeadlineExceeded):
+                wire_call(("127.0.0.1", port),
+                          ("traced", {"deadline_s": -0.5}, ("ping",)))
+        finally:
+            server.shutdown()
+
+    def test_budget_rides_the_wire(self):
+        """A generous client budget reaches the server shrunk by transit,
+        and the served call still succeeds."""
+        server, port = start_store_server()
+        try:
+            stores = RemoteStores(("127.0.0.1", port))
+            with deadline_mod.bind(Deadline.after(10.0)):
+                assert stores.ping() == "pong"
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Stale-connection poisoning (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestStaleConnections:
+    @staticmethod
+    def _one_shot_peer(respond: bool):
+        """A fake peer that serves at most one frame on one connection,
+        then hangs up — the peer-restarted-between-calls FIN. Returns
+        (port, thread, listener)."""
+        import socket as socketlib
+
+        from cadence_tpu.rpc.wire import (
+            recv_frame,
+            send_frame,
+            verify_hello,
+        )
+
+        listener = socketlib.socket()
+        listener.setsockopt(socketlib.SOL_SOCKET,
+                            socketlib.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+
+        def serve():
+            conn, _ = listener.accept()
+            try:
+                verify_hello(conn)
+                recv_frame(conn)
+                if respond:
+                    send_frame(conn, ("ok", "pong"))
+            finally:
+                conn.close()
+                listener.close()
+
+        thread = threading.Thread(target=serve, daemon=True)
+        thread.start()
+        return port, thread
+
+    def test_peer_restart_between_calls_does_not_wedge_the_thread(self):
+        # leg 1: the peer answers one ping, then closes (restart FIN);
+        # the pool caches the now-stale connection
+        port, thread = self._one_shot_peer(respond=True)
+        stores = RemoteStores(("127.0.0.1", port))
+        assert stores.ping() == "pong"
+        thread.join(timeout=5)
+        # leg 2: the peer comes back on the SAME port; the pool must drop
+        # the poisoned per-thread slot and dial fresh — transparently,
+        # because ping is idempotent and the retry tier owns the resend
+        server2, _ = start_store_server(port=port)
+        try:
+            assert stores.ping() == "pong"
+        finally:
+            server2.shutdown()
+
+    def test_receive_failure_drops_the_pooled_connection(self):
+        """After a receive-side failure on a NON-idempotent op the error
+        surfaces (no blind resend) and the per-thread Connection object is
+        discarded — the next call dials fresh instead of reusing a corpse."""
+        port, thread = self._one_shot_peer(respond=False)
+        stores = RemoteStores(("127.0.0.1", port))
+        pool = stores._pool
+        with pytest.raises((ConnectionError, OSError)):
+            stores.execution.update_workflow("d", "w", "r", None)
+        thread.join(timeout=5)
+        assert getattr(pool._local, "conn", None) is None
+
+
+# ---------------------------------------------------------------------------
+# Wire chaos
+# ---------------------------------------------------------------------------
+
+
+class TestWireChaos:
+    def test_parse_spec(self):
+        chaos = chaos_mod.parse_spec("drop=0.2,sever=0.1,delay=0.5,"
+                                     "delay_ms=5,seed=9")
+        assert (chaos.drop, chaos.sever, chaos.delay) == (0.2, 0.1, 0.5)
+        assert chaos.delay_ms == 5 and isinstance(chaos.counts(), dict)
+        with pytest.raises(ValueError):
+            chaos_mod.parse_spec("dorp=0.2")
+
+    def test_store_fault_spec_parses(self):
+        injector = _parse_fault_spec("rate=0.25,seed=3,writes_only=0")
+        assert injector.rate == 0.25 and injector.writes_only is False
+        with pytest.raises(ValueError):
+            _parse_fault_spec("rat=0.25")
+
+    def test_retry_tier_heals_chaos(self):
+        """Seeded drop+sever+delay chaos on every request leg: the _Pool
+        retry tier pushes every call through, and the injector actually
+        fired (the run exercised real faults, not a lucky seed)."""
+        server, port = start_store_server()
+        chaos = WireChaos(drop=0.25, sever=0.15, delay=0.3, delay_ms=2,
+                          seed=11)
+        chaos_mod.install(chaos)
+        try:
+            stores = RemoteStores(("127.0.0.1", port))
+            for _ in range(40):
+                assert stores.ping() == "pong"
+            counts = chaos.counts()
+            assert counts["drops"] > 0 and counts["severs"] > 0
+            assert counts["delays"] > 0
+        finally:
+            chaos_mod.uninstall()
+            server.shutdown()
+
+    def test_torn_frame_never_dispatches(self):
+        """A severed request is discarded whole by the server: the op it
+        carried must NOT have been applied (the nothing-was-applied
+        guarantee that makes ChaosError universally retryable)."""
+        stores_bundle = Stores()
+        server, port = start_store_server(stores=stores_bundle)
+        chaos = WireChaos(sever=1.0, seed=1)
+        chaos_mod.install(chaos)
+        try:
+            remote = RemoteStores(("127.0.0.1", port))
+            with pytest.raises((ChaosError, ConnectionError)):
+                remote.queue.enqueue("q", b"payload")
+            assert chaos.counts()["severs"] > 0
+        finally:
+            chaos_mod.uninstall()
+        try:
+            assert stores_bundle.queue.size("q") == 0
+        finally:
+            server.shutdown()
+
+    def test_breaker_open_surfaces_as_service_busy(self):
+        """FrontendClient translates its own breaker shedding into the
+        typed ServiceBusy after retries exhaust (degrade, don't hang)."""
+        from cadence_tpu.rpc.cluster import FrontendClient
+        from cadence_tpu.utils.circuitbreaker import DEFAULT_BREAKERS
+
+        client = FrontendClient(("127.0.0.1", 1))
+        breaker = DEFAULT_BREAKERS.for_target(("127.0.0.1", 1))
+        breaker.reset_timeout_s = 60.0
+        for _ in range(breaker.failure_threshold):
+            breaker.on_failure()
+        assert breaker.state() == OPEN
+        client.RETRIES = 2
+        client.BACKOFF_S = 0.01
+        with pytest.raises(ServiceBusy):
+            client.describe_domain("d")
