@@ -181,6 +181,17 @@ def main(argv=None) -> int:
     wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
     wal_grp.add_parser("scan")
     wal_grp.add_parser("clean")
+    # recovery fsck: typed findings over the raw stream + the rebuild
+    wal_grp.add_parser("fsck")
+    # kill-anywhere cut-point sweep (engine/crashsim.py)
+    cs = wal_grp.add_parser("crashsim")
+    cs.add_argument("--stride", type=int, default=1,
+                    help="recover at every Nth record boundary (1 = all)")
+    cs.add_argument("--no-torn", action="store_true",
+                    help="skip torn mid-record tails (JSONL only)")
+    cs.add_argument("--seed-workload", type=int, default=0, metavar="N",
+                    help="record an N-workflow seeded workload into the "
+                         "WAL first (refuses to overwrite an existing one)")
 
     # continuous canary (canary/cron.go)
     can = sub.add_parser("canary").add_subparsers(dest="cmd", required=True)
@@ -465,11 +476,44 @@ def _wal_tool(args) -> int:
     (atomic replace, like the schema migrator)."""
     import json as _json
 
-    from .engine.durability import WAL_VERSION, SqliteLog, is_sqlite_path
+    from .engine.durability import (
+        WAL_VERSION,
+        SchemaVersionError,
+        SqliteLog,
+        is_sqlite_path,
+        migrate_records,
+        version_record,
+    )
+
+    if args.cmd == "crashsim":
+        from .engine.crashsim import CrashSim, seed_workload
+        if args.seed_workload:
+            if os.path.exists(args.wal):
+                _emit({"error": f"refusing to seed over existing WAL "
+                                f"{args.wal}"})
+                return 1
+            seed_workload(args.wal, num_workflows=args.seed_workload)
+        if not os.path.exists(args.wal):
+            _emit({"error": f"no WAL at {args.wal}"})
+            return 1
+        report = CrashSim(args.wal).run(torn=not args.no_torn,
+                                        stride=args.stride)
+        _emit(report.summary())
+        return 0 if report.ok else 1
 
     if not os.path.exists(args.wal):
         _emit({"error": f"no WAL at {args.wal}"})
         return 1
+
+    if args.cmd == "fsck":
+        from .engine.walcheck import fsck
+        report = fsck(args.wal)
+        out = report.as_dict()
+        if report.recovery is not None:
+            out["executions_rebuilt"] = report.recovery.executions_rebuilt
+            out["open_workflows"] = report.recovery.open_workflows
+        _emit(out)
+        return 0 if report.ok else 1
     records, bad = [], 0
     if is_sqlite_path(args.wal):
         raw_lines = SqliteLog.read_raw(args.wal)
@@ -500,21 +544,29 @@ def _wal_tool(args) -> int:
         return 0 if bad == 0 else 1
 
     # clean: drop corrupt lines + every record of a tombstoned run (and
-    # the tombstone itself — replay without both is equivalent)
+    # the tombstone itself — replay without both is equivalent). Kept
+    # records are MIGRATED to WAL_VERSION before the header is written:
+    # positional labeling means anything under the header claims the
+    # header's version, so rewriting a v1 prefix unmigrated would
+    # re-label it current-version — exactly the corruption `wal fsck`
+    # flags as stale-migration-label.
     def run_key(rec):
         if rec.get("t") in ("h", "f", "cb", "cur", "delw"):
             return (rec.get("d"), rec.get("w"), rec.get("r"))
         return None
 
-    kept = [rec for rec in records
-            if rec.get("t") != "ver" and run_key(rec) not in tombstoned]
+    try:
+        migrated, _original = migrate_records(records)
+    except SchemaVersionError as exc:
+        _emit({"error": str(exc)})
+        return 1
+    kept = [rec for rec in migrated if run_key(rec) not in tombstoned]
     if is_sqlite_path(args.wal):
-        SqliteLog.rewrite(args.wal,
-                          [{"t": "ver", "v": version}] + kept)
+        SqliteLog.rewrite(args.wal, [version_record()] + kept)
     else:
         tmp = args.wal + ".clean"
         with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write(_json.dumps({"t": "ver", "v": version},
+            fh.write(_json.dumps(version_record(),
                                  separators=(",", ":")) + "\n")
             for rec in kept:
                 fh.write(_json.dumps(rec, separators=(",", ":")) + "\n")
@@ -523,7 +575,7 @@ def _wal_tool(args) -> int:
         os.replace(tmp, args.wal)
     _emit({"cleaned": args.wal, "dropped_bad_lines": bad,
            "dropped_records": len(records) - len(kept),
-           "kept": len(kept) + 1})
+           "schema_version": WAL_VERSION, "kept": len(kept) + 1})
     return 0
 
 

@@ -195,6 +195,12 @@ def _event_wire_attrs(ev: HistoryEvent) -> List[tuple]:
         num(A_CHILD_WF_ONLY, "child_workflow_only")
         if et == EventType.SignalExternalWorkflowExecutionInitiated:
             string(A_SIGNAL_NAME, "signal_name")
+    elif et == EventType.WorkflowExecutionSignaled:
+        # signal name + request id must survive the WAL/replication
+        # round-trip: replay rebuilds the signal dedup set from the event
+        # (a redelivered request id after recovery must stay a no-op)
+        string(A_SIGNAL_NAME, "signal_name")
+        string(A_REQUEST_ID, "request_id")
     elif et == EventType.WorkflowExecutionContinuedAsNew:
         string(A_NEW_RUN_ID, "new_execution_run_id")
     elif et == EventType.ChildWorkflowExecutionStarted:

@@ -42,6 +42,7 @@ from ..oracle.mutable_state import (
     VersionHistoryItem,
 )
 from ..oracle.state_builder import StateBuilder
+from . import crashpoints
 from .persistence import (
     CurrentExecution,
     DomainInfo,
@@ -89,10 +90,28 @@ class DurableLog:
     def append(self, record: dict) -> None:
         line = json.dumps(record, separators=(",", ":"))
         with self._lock:
+            point = crashpoints.active()
+            if point is not None:
+                if point.should_fire(crashpoints.SITE_BEFORE_WRITE, record):
+                    point.crash("no byte written")
+                if point.should_fire(crashpoints.SITE_MID_RECORD, record):
+                    # torn write: flush+fsync a PREFIX of the record so the
+                    # partial line genuinely reaches recovery's read path
+                    keep = max(1, int(len(line) * point.torn_fraction))
+                    self._fh.write(line[:keep])
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    point.crash(f"torn after {keep}/{len(line)} bytes")
             self._fh.write(line + "\n")
             self._fh.flush()
+            if point is not None and point.should_fire(
+                    crashpoints.SITE_AFTER_WRITE, record):
+                point.crash("flushed, not fsynced")
             if self.fsync:
                 os.fsync(self._fh.fileno())
+            if point is not None and point.should_fire(
+                    crashpoints.SITE_AFTER_FSYNC, record):
+                point.crash("durable")
 
     def close(self) -> None:
         with self._lock:
@@ -148,9 +167,23 @@ class SqliteLog:
     def append(self, record: dict) -> None:
         body = json.dumps(record, separators=(",", ":"))
         with self._lock:
+            point = crashpoints.active()
+            if point is not None and point.should_fire(
+                    crashpoints.SITE_BEFORE_WRITE, record):
+                point.crash("no row inserted")
             self._conn.execute("INSERT INTO records(body) VALUES (?)",
                                (body,))
+            # transactional backend: "mid-record" dies between INSERT and
+            # COMMIT — the row vanishes, SQLite's whole torn-write story
+            if point is not None and point.should_fire(
+                    crashpoints.SITE_MID_RECORD, record):
+                self._conn.rollback()  # the dying process's txn is lost
+                point.crash("inserted, not committed")
             self._conn.commit()
+            for site in (crashpoints.SITE_AFTER_WRITE,
+                         crashpoints.SITE_AFTER_FSYNC):
+                if point is not None and point.should_fire(site, record):
+                    point.crash("committed")
 
     def close(self) -> None:
         with self._lock:
@@ -333,6 +366,15 @@ def history_record(domain_id: str, workflow_id: str, run_id: str,
     blob = serialize_history([HistoryBatch(
         domain_id=domain_id, workflow_id=workflow_id, run_id=run_id,
         events=list(events))])
+    return history_record_from_blob(domain_id, workflow_id, run_id, branch,
+                                    blob)
+
+
+def history_record_from_blob(domain_id: str, workflow_id: str, run_id: str,
+                             branch: int, blob: bytes) -> dict:
+    """The commit path serializes its batch exactly once (for history-size
+    accounting) and hands the bytes down here — never a second
+    serialize_history pass per transaction."""
     return {"t": "h", "d": domain_id, "w": workflow_id, "r": run_id,
             "b": branch, "blob": base64.b64encode(blob).decode("ascii")}
 

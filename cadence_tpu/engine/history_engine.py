@@ -387,6 +387,11 @@ class HistoryEngine:
                              request_id=request_id or str(uuid.uuid4()))
         sb = StateBuilder(ms)
         sb.apply_batch(batch)
+        # the start batch counts toward history size like every later
+        # transaction's; the bytes double as the WAL record's blob
+        from ..core.codec import serialize_history
+        start_blob = serialize_history([batch])
+        ms.history_size = len(start_blob)
 
         # history FIRST (the reference's events-first ordering,
         # context.go PersistStartWorkflowBatchEvents before
@@ -394,7 +399,8 @@ class HistoryEngine:
         # orphan history under a never-registered run ID — harmless; the
         # execution row is the commit point, so a retried start (fresh run
         # ID) starts clean
-        self.shard.append_history(domain_id, workflow_id, run_id, events)
+        self.shard.append_history(domain_id, workflow_id, run_id, events,
+                                  blob=start_blob)
         self.shard.insert_tasks(domain_id, workflow_id, run_id,
                                 ms.transfer_tasks, ms.timer_tasks)
         self.shard.create_workflow(ms)  # commit point
@@ -969,14 +975,20 @@ class HistoryEngine:
             return
         if request_id:
             ms.signal_requested_ids.add(request_id)
+        # the request id rides the event itself so StateBuilder replay
+        # (recovery, standby rebuild, NDC) repopulates the dedup set — a
+        # cross-cluster redelivery AFTER a crash must still be a no-op
+        attrs = dict(signal_name=signal_name)
+        if request_id:
+            attrs["request_id"] = request_id
         if self._has_inflight_decision(ms):
             # buffered until the in-flight decision closes; no new decision
             # scheduled (one is already running)
             self._buffer_event(ms, expected, EventType.WorkflowExecutionSignaled,
-                               signal_name=signal_name)
+                               **attrs)
             return
         txn = self._new_transaction(ms)
-        txn.add(EventType.WorkflowExecutionSignaled, signal_name=signal_name)
+        txn.add(EventType.WorkflowExecutionSignaled, **attrs)
         self._maybe_schedule_decision(txn, ms)
         txn.commit(expected)
 
@@ -1604,9 +1616,12 @@ class _Txn:
         # replay paths clear it (state_builder.go:108)
         StateBuilder(self.ms, clear_sticky=False).apply_batch(batch)
         # history-size accounting (mutableState GetHistorySize): the
-        # codec-serialized batch is what the store pays for this commit
+        # codec-serialized batch is what the store pays for this commit;
+        # the SAME bytes become the WAL record's blob below — one
+        # serialize_history per transaction, not two
         from ..core.codec import serialize_history
-        self.ms.history_size += len(serialize_history([batch]))
+        events_blob = serialize_history([batch])
+        self.ms.history_size += len(events_blob)
         new_transfer = list(self.ms.transfer_tasks)
         new_timer = list(self.ms.timer_tasks)
         if self.drop_stale_decision_tasks:
@@ -1631,7 +1646,7 @@ class _Txn:
         try:
             version = self.engine.shard.commit_workflow(
                 self.ms, expected_next_event_id, self.events,
-                new_transfer, new_timer)
+                new_transfer, new_timer, events_blob=events_blob)
         except Exception:
             # the entry that fed this transaction may be stale (a foreign
             # writer won) — drop it so the caller's retry reads fresh

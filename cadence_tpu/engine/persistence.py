@@ -32,6 +32,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..core.events import HistoryBatch, HistoryEvent
 from ..oracle.mutable_state import MutableState
+from . import crashpoints
 
 
 class ConditionFailedError(Exception):
@@ -132,8 +133,12 @@ class HistoryStore:
 
     def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
                      events: List[HistoryEvent],
-                     branch: Optional[int] = None) -> None:
-        """Append a batch; contiguity enforced per branch.
+                     branch: Optional[int] = None,
+                     blob: Optional[bytes] = None) -> None:
+        """Append a batch; contiguity enforced per branch. `blob` is the
+        caller's already-serialized bytes for exactly these events (the
+        commit path pays serialize_history once, for history-size
+        accounting, and the WAL record reuses it).
 
         Re-appending at an id the branch already holds OVERWRITES the tail
         from that id (Cassandra history-node overwrite semantics,
@@ -143,6 +148,7 @@ class HistoryStore:
         wedge the branch. A gap (first id beyond the tail) still fails."""
         if not events:
             raise ValueError("empty history batch")
+        crashpoints.fire("store.history.append_batch")
         key = (domain_id, workflow_id, run_id)
         with self._lock:
             branches = self._branches.setdefault(key, [[]])
@@ -173,9 +179,13 @@ class HistoryStore:
                             f"history overwrite leaves a gap before {first}")
             target.append(list(events))
             if self._wal is not None:
-                from .durability import history_record
-                self._wal.append(history_record(domain_id, workflow_id,
-                                                run_id, index, events))
+                from .durability import history_record, history_record_from_blob
+                self._wal.append(
+                    history_record_from_blob(domain_id, workflow_id, run_id,
+                                             index, blob)
+                    if blob is not None else
+                    history_record(domain_id, workflow_id, run_id, index,
+                                   events))
 
     def fork_branch(self, domain_id: str, workflow_id: str, run_id: str,
                     source_branch: int, fork_event_id: int) -> int:
@@ -335,6 +345,7 @@ class ExecutionStore:
     def create_workflow(self, shard_id: int, range_id: int, ms: MutableState) -> None:
         """CreateWorkflowExecution (shard/context.go:586): fails when a
         current run exists and is still open."""
+        crashpoints.fire("store.execution.create_workflow")
         info = ms.execution_info
         key = (info.domain_id, info.workflow_id, info.run_id)
         cur_key = (info.domain_id, info.workflow_id)
@@ -357,6 +368,7 @@ class ExecutionStore:
                         expected_next_event_id: int) -> None:
         """UpdateWorkflowExecution (shard/context.go:696): conditional on the
         next-event-id recorded when the transaction loaded the state."""
+        crashpoints.fire("store.execution.update_workflow")
         info = ms.execution_info
         key = (info.domain_id, info.workflow_id, info.run_id)
         with self._lock:
@@ -858,6 +870,7 @@ class QueueStore:
         self._acks: Dict[Tuple[str, str], int] = {}
 
     def enqueue(self, queue: str, payload: object) -> int:
+        crashpoints.fire("store.queue.enqueue")
         with self._lock:
             q = self._queues.setdefault(queue, [])
             q.append(payload)
@@ -898,14 +911,29 @@ class QueueStore:
         with self._lock:
             return {c: i for (q, c), i in self._acks.items() if q == queue}
 
+    def snapshot(self) -> Tuple[Dict[str, int], Dict[Tuple[str, str], int]]:
+        """(queue → size, (queue, consumer) → acked index) in one lock
+        hold — the walcheck fsck's consistency view."""
+        with self._lock:
+            return ({q: len(items) for q, items in self._queues.items()},
+                    dict(self._acks))
+
     def purge(self, queue: str) -> int:
-        """Drop every item (the DLQ purge verb). Whole-queue only: index
+        """Drop every item (the DLQ purge verb) AND the queue's consumer
+        ack levels: an ack level outliving a purge points past the queue's
+        contents, so items re-enqueued after the purge would be silently
+        skipped by every resuming consumer. Whole-queue only: index
         cursors of streaming consumers stay valid because purged queues
-        are read-whole (DLQ semantics), never cursor-streamed."""
+        are read-whole (DLQ semantics), never cursor-streamed. Recovery
+        replays the purge record through this same method, so the ack
+        reset survives a crash too."""
         with self._lock:
             n = len(self._queues.get(queue, []))
             self._queues[queue] = []
-            if self._wal is not None and n:
+            stale_acks = [k for k in self._acks if k[0] == queue]
+            for k in stale_acks:
+                del self._acks[k]
+            if self._wal is not None and (n or stale_acks):
                 from .durability import queue_purge_record
                 self._wal.append(queue_purge_record(queue))
             return n

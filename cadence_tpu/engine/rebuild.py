@@ -61,6 +61,20 @@ class RebuildStats:
             self.kernel_errors[code] = self.kernel_errors.get(code, 0) + n
 
 
+def _rebuilt_history_size(batches: Sequence[HistoryBatch],
+                          run_id: str) -> int:
+    """Reconstruct mutableState GetHistorySize from the stored batches'
+    serialized sizes (one batch == one committed transaction == one WAL
+    blob): recovery and standby rebuild must not hand back states whose
+    size accounting silently reset to zero — the history-size limits
+    would stop protecting exactly the workflows that just failed over.
+    For a continue-as-new chain only the final run's batches count (the
+    new run starts its own accounting)."""
+    from ..core.codec import serialize_history
+    return sum(len(serialize_history([b])) for b in batches
+               if b.run_id == run_id)
+
+
 class DeviceRebuilder:
     """Batched device replay → full MutableState objects."""
 
@@ -173,6 +187,8 @@ class DeviceRebuilder:
             sb.apply_batch(b)
         ms = sb.new_run_state if sb.new_run_state is not None else sb.ms
         ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
+        ms.history_size = _rebuilt_history_size(batches,
+                                                ms.execution_info.run_id)
         return ms
 
     def _hydrate(self, arrs, i: int, batches: Sequence[HistoryBatch],
@@ -202,6 +218,7 @@ class DeviceRebuilder:
             return None
         ms = sb.ms
         ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
+        ms.history_size = _rebuilt_history_size(last_run, last_run[0].run_id)
         info = ms.execution_info
 
         # scan-dependent execution scalars from the device

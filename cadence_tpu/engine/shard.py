@@ -113,7 +113,7 @@ class ShardContext:
                 raise
 
     def append_history(self, domain_id: str, workflow_id: str, run_id: str,
-                       events, branch=None) -> None:
+                       events, branch=None, blob=None) -> None:
         """Fenced history append: a deposed owner must NOT reach the
         history store — with node-overwrite append semantics a stale
         writer could truncate committed events before its state update
@@ -128,7 +128,8 @@ class ShardContext:
                     f"shard {self.shard_id}: append fenced (range "
                     f"{self._info.range_id} != {current.range_id})")
             self._stores.history.append_batch(domain_id, workflow_id,
-                                              run_id, events, branch=branch)
+                                              run_id, events, branch=branch,
+                                              blob=blob)
 
     def update_workflow(self, ms: MutableState,
                         expected_next_event_id: int) -> int:
@@ -146,7 +147,8 @@ class ShardContext:
 
     def commit_workflow(self, ms: MutableState, expected_next_event_id: int,
                         events, transfer: List[GeneratedTask],
-                        timer: List[GeneratedTask]) -> None:
+                        timer: List[GeneratedTask],
+                        events_blob: Optional[bytes] = None) -> None:
         """Atomic transaction commit: events → tasks → fenced state update
         under ONE shard lock hold, with the state CAS prechecked first.
 
@@ -164,7 +166,7 @@ class ShardContext:
                 info.domain_id, info.workflow_id, info.run_id,
                 expected_next_event_id)
             self.append_history(info.domain_id, info.workflow_id,
-                                info.run_id, events)
+                                info.run_id, events, blob=events_blob)
             self.insert_tasks(info.domain_id, info.workflow_id, info.run_id,
                               transfer, timer)
             return self.update_workflow(ms, expected_next_event_id)

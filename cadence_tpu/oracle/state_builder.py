@@ -228,6 +228,13 @@ class StateBuilder:
 
         elif et == EventType.WorkflowExecutionSignaled:
             ms.execution_info.signal_count += 1  # :3260-3267
+            # repopulate the at-least-once dedup set from the event's
+            # request id (mutable_state_builder.go AddSignalRequested on
+            # the replicate path): a redelivered cross-cluster signal
+            # after recovery/promotion must stay a no-op
+            request_id = event.get("request_id", "")
+            if request_id:
+                ms.signal_requested_ids.add(request_id)
 
         elif et == EventType.WorkflowExecutionCancelRequested:
             ms.execution_info.cancel_requested = True  # :2688-2694

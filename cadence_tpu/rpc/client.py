@@ -195,7 +195,20 @@ class _Pool:
                 relayed = getattr(exc, "_wire_relayed", False)
                 if (isinstance(exc, (ConnectionError, OSError, WireError))
                         and not relayed):
-                    breaker.on_failure()
+                    # a transport failure with the caller's deadline budget
+                    # EXHAUSTED is the same case as DeadlineExceeded above,
+                    # just detected mid-flight: the socket timeout was
+                    # clamped to the remaining budget (wire.effective_
+                    # timeout), so a healthy peer at normal latency still
+                    # times out. Charging the breaker here would let a few
+                    # tight-deadline callers open it against a healthy
+                    # target for everyone. Drop the socket (its stream
+                    # state is unknown) but stay breaker-neutral.
+                    current = deadline_mod.current()
+                    if current is not None and current.remaining() <= 0:
+                        breaker.on_probe_abandoned()
+                    else:
+                        breaker.on_failure()
                     self._drop_connection()
                 else:
                     # a typed SERVICE error is a healthy peer answering

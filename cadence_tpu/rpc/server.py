@@ -207,6 +207,12 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         if chaos_spec:
             from . import chaos as chaos_mod
             chaos_mod.install(chaos_mod.parse_spec(chaos_spec))
+        # durability crashpoints ride the same contract (env var for
+        # subprocesses, dynamicconfig for operator overrides)
+        crash_spec = self.config.get(dc.KEY_CRASHPOINT)
+        if crash_spec:
+            from ..engine import crashpoints
+            crashpoints.install(crashpoints.parse_spec(crash_spec))
         self.tracer = tracing.DEFAULT_TRACER
         #: HTTP scrape surface (/metrics, /health, /traces): bound in
         #: __init__ so the port is known before start(); 0 = ephemeral

@@ -60,15 +60,28 @@ def effective_timeout(base: float = DEFAULT_TIMEOUT_S) -> float:
 # -- connection authentication ---------------------------------------------
 #
 # The trust-boundary docstring above is ENFORCED, not just declared: every
-# connection opens with a 32-byte HMAC-SHA256 preamble keyed by a
-# per-cluster shared secret; a peer that cannot produce it is disconnected
-# before any frame is unpickled. The secret comes from
-# CADENCE_TPU_WIRE_SECRET (explicit per-cluster deployment), falling back
-# to a 0600 per-user secret file — so on a multi-user host, reaching the
-# port is not enough: an unrelated local user cannot read the key material.
+# connection opens with a challenge-response handshake keyed by a
+# per-cluster shared secret — the server sends a fresh random nonce, the
+# client answers HMAC-SHA256(secret, nonce || context) — so a recorded
+# preamble is worthless on the next connection (replay-proof); a peer that
+# cannot produce the response is disconnected before any frame is
+# unpickled. The ORIGINAL static preamble, HMAC(secret, context) with no
+# nonce, is kept only as a documented LEGACY fallback: the server still
+# accepts it unless CADENCE_TPU_WIRE_ALLOW_STATIC=0, which closes the
+# replay window — set it once every peer in the cluster speaks the
+# challenge. The fallback is ONE-directional by design: it covers OLD
+# clients dialing NEW servers, so a rolling upgrade must roll the server
+# side first (a new client dialing an old server would wait for a nonce
+# that never comes and burn its socket timeout). The secret comes
+# from CADENCE_TPU_WIRE_SECRET (explicit per-cluster deployment), falling
+# back to a 0600 per-user secret file — so on a multi-user host, reaching
+# the port is not enough: an unrelated local user cannot read the key
+# material.
 
 _HELLO_CTX = b"cadence-tpu-wire-v1"
 _HELLO_LEN = hashlib.sha256().digest_size
+_NONCE_LEN = 32
+_LEGACY_ENV = "CADENCE_TPU_WIRE_ALLOW_STATIC"
 _SECRET_CACHE: Optional[bytes] = None
 
 
@@ -100,20 +113,40 @@ def cluster_secret() -> bytes:
 
 
 def _hello_mac() -> bytes:
+    """The LEGACY static preamble (pre-challenge peers)."""
     return hmac.new(cluster_secret(), _HELLO_CTX, hashlib.sha256).digest()
 
 
+def _challenge_mac(nonce: bytes) -> bytes:
+    return hmac.new(cluster_secret(), nonce + _HELLO_CTX,
+                    hashlib.sha256).digest()
+
+
+def _legacy_allowed() -> bool:
+    return os.environ.get(_LEGACY_ENV, "1") not in ("0", "false", "no")
+
+
 def send_hello(sock: socket.socket) -> None:
-    """Client side of the preamble: first bytes on every connection."""
-    sock.sendall(_hello_mac())
+    """Client side of the handshake: read the server's fresh nonce, answer
+    HMAC(secret, nonce || context) — the response only opens THIS
+    connection; replaying it elsewhere fails against a different nonce."""
+    nonce = _read_exact(sock, _NONCE_LEN)
+    sock.sendall(_challenge_mac(nonce))
 
 
 def verify_hello(sock: socket.socket) -> None:
-    """Server side: read+check the preamble BEFORE the first pickle load.
-    Raises WireError (and the caller drops the connection) on mismatch."""
+    """Server side: challenge, then read+check the response BEFORE the
+    first pickle load. Raises WireError (and the caller drops the
+    connection) on mismatch. The static legacy preamble is accepted only
+    while CADENCE_TPU_WIRE_ALLOW_STATIC permits it."""
+    nonce = os.urandom(_NONCE_LEN)
+    sock.sendall(nonce)
     mac = _read_exact(sock, _HELLO_LEN)
-    if not hmac.compare_digest(mac, _hello_mac()):
-        raise WireError("unauthenticated peer (bad cluster secret)")
+    if hmac.compare_digest(mac, _challenge_mac(nonce)):
+        return
+    if _legacy_allowed() and hmac.compare_digest(mac, _hello_mac()):
+        return
+    raise WireError("unauthenticated peer (bad cluster secret)")
 
 
 def _encode_frame(obj: Any) -> Tuple[bytes, bytes]:

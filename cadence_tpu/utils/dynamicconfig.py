@@ -64,6 +64,10 @@ KEY_RPC_BREAKER_RESET_TIMEOUT_S = "rpc.breakerResetSeconds"
 # equivalent for subprocess clusters)
 KEY_WIRE_CHAOS = "rpc.wireChaos"
 
+#: durability crashpoint spec ("" = disarmed), e.g.
+#: "site=wal.append.after-write,hit=3,mode=kill" (engine/crashpoints.py)
+KEY_CRASHPOINT = "durability.crashpoint"
+
 _DEFAULTS: Dict[str, Any] = {
     KEY_MAX_ACTIVITIES: 16,
     KEY_MAX_TIMERS: 16,
@@ -95,6 +99,7 @@ _DEFAULTS: Dict[str, Any] = {
     KEY_RPC_BREAKER_FAILURE_THRESHOLD: 5,
     KEY_RPC_BREAKER_RESET_TIMEOUT_S: 5,
     KEY_WIRE_CHAOS: "",
+    KEY_CRASHPOINT: "",
 }
 
 

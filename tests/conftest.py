@@ -47,4 +47,17 @@ def _fresh_default_observability():
     circuitbreaker.DEFAULT_BREAKERS.reset()
     from cadence_tpu.rpc import chaos
     chaos.uninstall()
+    # durability crashpoints are process-global the same way: one test's
+    # armed kill site must never fire inside another test's WAL append
+    from cadence_tpu.engine import crashpoints
+    crashpoints.uninstall()
     yield
+
+
+@pytest.fixture(params=["jsonl", "sqlite"])
+def wal(request, tmp_path):
+    """One durable-WAL path per open_log backend: every crash/fault/
+    recovery test requesting this fixture runs the full matrix over both
+    JSONL and SqliteLog (backend selected by extension)."""
+    return str(tmp_path /
+               ("wal.db" if request.param == "sqlite" else "wal.jsonl"))

@@ -1,0 +1,460 @@
+"""Crash-consistency harness: kill-anywhere cut points, recovery fsck,
+and dual-backend recovery parity.
+
+The durability guarantee under test: for ANY prefix of the WAL — the
+process may die between any two record writes, mid-record, or by SIGKILL
+at an armed crashpoint — full recovery yields mutable states
+byte-identical to ones the fault-free run committed, the recovery fsck
+reports zero findings, and the task refresher regenerates work for
+exactly the current runs. Everything here runs over BOTH open_log
+backends (JSONL and SQLite) unless a case is physically backend-specific
+(only JSONL has torn tails)."""
+import json
+import os
+
+import pytest
+
+from cadence_tpu.core.enums import CloseStatus, DecisionType
+from cadence_tpu.engine import crashpoints, walcheck
+from cadence_tpu.engine.crashpoints import CrashPoint, SimulatedCrash
+from cadence_tpu.engine.crashsim import CrashSim, seed_workload
+from cadence_tpu.engine.durability import (
+    open_durable_stores,
+    read_log,
+    recover_stores,
+)
+from cadence_tpu.engine.history_engine import Decision
+from cadence_tpu.engine.onebox import Onebox
+
+pytestmark = pytest.mark.crash
+
+BACKENDS = ("jsonl", "sqlite")
+DOMAIN = "crash-domain"
+TL = "crash-tl"
+
+
+def _wal_name(backend: str) -> str:
+    return "wal.db" if backend == "sqlite" else "wal.jsonl"
+
+
+# per-test dual-backend `wal` fixture: tests/conftest.py
+
+
+@pytest.fixture(scope="module", params=BACKENDS)
+def seeded_wal(request, tmp_path_factory):
+    """One recorded workload per backend, shared by the read-only tests."""
+    path = str(tmp_path_factory.mktemp("crashsim") / _wal_name(request.param))
+    seed_workload(path, num_workflows=4)
+    return path
+
+
+def _zero_findings(path, stores):
+    findings = (walcheck.audit_records(walcheck.read_raw_lines(path))
+                + walcheck.audit_stores(stores))
+    assert findings == [], [f.as_dict() for f in findings]
+
+
+class TestCutPointMatrix:
+    def test_every_cut_recovers_prefix_consistent(self, seeded_wal):
+        """The tentpole gate: recovery at EVERY record boundary (and, on
+        JSONL, at every torn mid-record tail) yields checksums that are a
+        prefix-consistent subset of the fault-free run, with zero fsck
+        findings and a refresher task for every current run."""
+        sim = CrashSim(seeded_wal)
+        report = sim.run(torn=True, stride=1)
+        assert report.records > 40
+        assert report.ok, report.summary()
+        if sim.backend == "jsonl":
+            assert any(c.torn for c in report.cuts)
+        else:
+            assert not any(c.torn for c in report.cuts)  # atomic appends
+        # the full-log cut recovered everything the workload committed
+        final = report.cuts[-1]
+        assert final.cut == report.records and final.recovered_runs >= 4
+
+    def test_recovered_workload_drives_to_completion(self, seeded_wal):
+        """Recovery of the full log is not just checksum-clean — the open
+        workflows actually finish on the recovered cluster."""
+        stores, report = recover_stores(seeded_wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        assert report.ok and report.open_workflows >= 1
+        box = Onebox(num_hosts=1, num_shards=4, stores=stores)
+        assert box.refresh_all_tasks() > 0
+        box.pump_once()
+        complete = Decision(DecisionType.CompleteWorkflowExecution)
+        for _ in range(100):
+            resp = box.frontend.poll_for_activity_task(DOMAIN, TL)
+            if resp is not None:
+                box.frontend.respond_activity_task_completed(resp.token)
+            resp = box.frontend.poll_for_decision_task(DOMAIN, TL)
+            if resp is not None:
+                box.frontend.respond_decision_task_completed(resp.token,
+                                                             [complete])
+            if box.pump_once() == 0 and box.matching.backlog() == 0:
+                break
+        for rec in box.frontend.list_open_workflow_executions(DOMAIN):
+            pytest.fail(f"{rec.workflow_id} still open after recovery drive")
+
+
+class TestCrashpoints:
+    """Named injection sites: the in-process kill-anywhere loop."""
+
+    SITES = (crashpoints.SITE_BEFORE_WRITE, crashpoints.SITE_MID_RECORD,
+             crashpoints.SITE_AFTER_WRITE, crashpoints.SITE_AFTER_FSYNC)
+
+    def _workload_until_crash(self, wal):
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        crashed = False
+        try:
+            box.frontend.register_domain(DOMAIN)
+            for i in range(8):
+                box.frontend.start_workflow_execution(DOMAIN, f"cp-{i}",
+                                                      "t", TL)
+                box.frontend.signal_workflow_execution(DOMAIN, f"cp-{i}",
+                                                       "go")
+        except SimulatedCrash:
+            crashed = True
+        return crashed
+
+    def test_crash_at_every_wal_site_recovers_clean(self, wal):
+        """Arm each WAL site at several hit depths; every crash must leave
+        a WAL that recovers with zero fsck findings."""
+        for site in self.SITES:
+            for hit in (2, 5, 9):
+                if os.path.exists(wal):
+                    os.remove(wal)
+                crashpoints.install(CrashPoint(site, hit=hit))
+                crashed = self._workload_until_crash(wal)
+                crashpoints.uninstall()
+                assert crashed, f"{site} hit={hit} never fired"
+                stores, report = recover_stores(wal,
+                                                verify_on_device=False,
+                                                rebuild_on_device=False)
+                assert report.ok, (site, hit, report.divergent)
+                _zero_findings(wal, stores)
+
+    def test_crash_between_history_and_pointer_record(self, wal):
+        """A store-level site kills between the two WAL records of one
+        start transaction (history logged, current pointer not): the run
+        is quarantined, never surfaced open, and the id is startable."""
+        crashpoints.install(CrashPoint("store.execution.create_workflow",
+                                       hit=2))
+        crashed = self._workload_until_crash(wal)
+        crashpoints.uninstall()
+        assert crashed
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        assert report.ok
+        assert len(report.quarantined) == 1
+        _zero_findings(wal, stores)
+        box = Onebox(num_hosts=1, num_shards=2, stores=stores)
+        quarantined_wf = report.quarantined[0][1]
+        assert quarantined_wf not in [
+            r.workflow_id for r in
+            box.frontend.list_open_workflow_executions(DOMAIN)]
+        # the torn start's workflow id is startable again
+        box.frontend.start_workflow_execution(DOMAIN, quarantined_wf, "t",
+                                              TL)
+
+    def test_jsonl_torn_tail_really_on_disk(self, tmp_path):
+        """The mid-record site leaves a genuine partial line (fsynced), and
+        reopening the log heals it instead of welding onto garbage."""
+        from cadence_tpu.engine.durability import DurableLog
+        wal = str(tmp_path / "torn.jsonl")
+        log = DurableLog(wal)
+        log.append({"t": "ver", "v": 2})
+        crashpoints.install(CrashPoint(crashpoints.SITE_MID_RECORD,
+                                       torn_fraction=0.4))
+        with pytest.raises(SimulatedCrash):
+            log.append({"t": "cfg", "k": "crash-here", "v": 1, "dom": None})
+        crashpoints.uninstall()
+        log.close()
+        raw = open(wal, "rb").read()
+        assert not raw.endswith(b"\n")  # the tear is real
+        assert read_log(wal) == [{"t": "ver", "v": 2}]
+        log = DurableLog(wal)  # reopen: heals the tail before appending
+        log.append({"t": "cfg", "k": "after", "v": 2, "dom": None})
+        log.close()
+        assert [r.get("k") for r in read_log(wal)] == [None, "after"]
+
+    def test_sqlite_mid_record_is_invisible(self, tmp_path):
+        """SQLite's torn-write story: a crash between INSERT and COMMIT
+        loses the row entirely — recovery never sees a partial record."""
+        from cadence_tpu.engine.durability import SqliteLog
+        wal = str(tmp_path / "torn.db")
+        log = SqliteLog(wal)
+        log.append({"t": "ver", "v": 2})
+        crashpoints.install(CrashPoint(crashpoints.SITE_MID_RECORD))
+        with pytest.raises(SimulatedCrash):
+            log.append({"t": "cfg", "k": "never", "v": 1, "dom": None})
+        crashpoints.uninstall()
+        log.close()
+        assert read_log(wal) == [{"t": "ver", "v": 2}]
+
+    def test_spec_parsing(self):
+        point = crashpoints.parse_spec(
+            "site=wal.append.after-write,hit=3,mode=kill,type=h,torn=0.25")
+        assert (point.site, point.hit, point.mode, point.record_type,
+                point.torn_fraction) == ("wal.append.after-write", 3,
+                                         "kill", "h", 0.25)
+        with pytest.raises(ValueError):
+            crashpoints.parse_spec("hit=3")  # site is mandatory
+        with pytest.raises(ValueError):
+            crashpoints.parse_spec("site=x,bogus=1")
+
+    def test_record_type_filter(self, tmp_path):
+        """type=h arms the site for history records only — domain and
+        pointer records pass through untouched."""
+        from cadence_tpu.engine.durability import DurableLog
+        wal = str(tmp_path / "typed.jsonl")
+        log = DurableLog(wal)
+        crashpoints.install(CrashPoint(crashpoints.SITE_BEFORE_WRITE,
+                                       record_type="h"))
+        log.append({"t": "ver", "v": 2})
+        log.append({"t": "cfg", "k": "x", "v": 1, "dom": None})
+        with pytest.raises(SimulatedCrash):
+            log.append({"t": "h", "d": "d", "w": "w", "r": "r", "b": 0,
+                        "blob": ""})
+        crashpoints.uninstall()
+        log.close()
+        assert len(read_log(wal)) == 2
+
+
+class TestSigkillAtCrashpoint:
+    """Subprocess mode over the rpc/cluster launch seam: the store server
+    process is SIGKILLed by its own armed crashpoint mid-append; the WAL
+    it leaves behind recovers clean."""
+
+    def test_store_sigkilled_mid_append_recovers(self, tmp_path):
+        from cadence_tpu.rpc.cluster import launch
+        wal = str(tmp_path / "kill.jsonl")
+        cluster = launch(
+            num_hosts=1, num_shards=4, wal=wal,
+            env_extra={"CADENCE_TPU_CRASHPOINT":
+                       "site=wal.append.after-write,hit=14,mode=kill"})
+        try:
+            fe = cluster.frontend(0)
+            fe.register_domain(DOMAIN)
+            with pytest.raises(Exception):
+                for i in range(60):
+                    fe.start_workflow_execution(DOMAIN, f"kk-{i}", "t", TL)
+            deadline = __import__("time").monotonic() + 10
+            while __import__("time").monotonic() < deadline:
+                if cluster.store_proc.poll() is not None:
+                    break
+                __import__("time").sleep(0.1)
+            assert cluster.store_proc.poll() is not None, \
+                "store server survived its kill crashpoint"
+        finally:
+            cluster.stop()
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        assert report.ok
+        assert report.executions_rebuilt >= 1
+        _zero_findings(wal, stores)
+
+
+class TestFsck:
+    def test_clean_wal_has_zero_findings(self, seeded_wal):
+        report = walcheck.fsck(seeded_wal)
+        assert report.ok, report.as_dict()
+
+    def test_findings_surface_on_metrics(self, tmp_path):
+        from cadence_tpu.utils.metrics import MetricsRegistry
+        wal = str(tmp_path / "bad.jsonl")
+        with open(wal, "w") as fh:
+            fh.write(json.dumps({"t": "ver", "v": 2}) + "\n")
+            fh.write(json.dumps({"t": "qa", "q": "q1", "c": "c1",
+                                 "i": 7}) + "\n")
+        registry = MetricsRegistry()
+        report = walcheck.fsck(wal, metrics=registry)
+        assert [f.code for f in report.findings] == ["orphaned-ack"]
+        assert registry.counter("walcheck", "finding-orphaned-ack") == 1
+        assert "walcheck" in registry.to_prometheus()
+
+    def test_each_corruption_class_reports_typed_finding(self, tmp_path):
+        """stale migration label / dangling current pointer / orphaned
+        ack: one doctored log per class, one typed finding per log."""
+        cases = {
+            "stale-migration-label": [
+                {"t": "ver", "v": 2},
+                # v1-format domain record under a v2 header
+                {"t": "d", "id": "x", "name": "n", "ret": 1, "act": True,
+                 "ac": "primary", "cl": ["primary"], "fv": 0, "nv": 0}],
+            "dangling-current-pointer": [
+                {"t": "ver", "v": 2},
+                {"t": "cur", "d": "dd", "w": "ghost", "r": "r1", "st": 1,
+                 "cs": 0}],
+            "orphaned-ack": [
+                {"t": "ver", "v": 2},
+                {"t": "qa", "q": "q1", "c": "c1", "i": 5}],
+        }
+        for code, records in cases.items():
+            wal = str(tmp_path / f"{code}.jsonl")
+            with open(wal, "w") as fh:
+                for rec in records:
+                    fh.write(json.dumps(rec) + "\n")
+            report = walcheck.fsck(wal)
+            assert code in [f.code for f in report.findings], \
+                (code, report.as_dict())
+
+    def test_wal_clean_migrates_v1_prefix(self, tmp_path, capsys):
+        """The fixed `wal clean`: a v1 prefix under a current-version
+        header is MIGRATED, not re-labeled — fsck reports zero findings on
+        the cleaned log (the acceptance gate for ADVICE r5)."""
+        from cadence_tpu.cli import main as cli_main
+        wal = str(tmp_path / "v1.jsonl")
+        with open(wal, "w") as fh:
+            # pre-header v1 log (no version record, no v2 domain fields)
+            fh.write(json.dumps({"t": "d", "id": "d1", "name": "old",
+                                 "ret": 2, "act": True, "ac": "primary",
+                                 "cl": ["primary"], "fv": 0,
+                                 "nv": 0}) + "\n")
+        rc = cli_main(["--wal", wal, "wal", "clean"])
+        capsys.readouterr()
+        assert rc == 0
+        records = read_log(wal)
+        assert records[0] == {"t": "ver", "v": 2}
+        domain_rec = records[1]
+        assert {"st", "desc", "arc"} <= set(domain_rec)  # migrated body
+        report = walcheck.fsck(wal)
+        assert report.ok, report.as_dict()
+        assert report.stores.domain.by_name("old").retention_days == 2
+
+    def test_cli_fsck_verb(self, tmp_path, capsys):
+        from cadence_tpu.cli import main as cli_main
+        wal = str(tmp_path / "cli.jsonl")
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "fsck-wf", "t", TL)
+        box.stores.wal.close()
+        rc = cli_main(["--wal", wal, "wal", "fsck"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"] and out["findings"] == []
+        # doctor an orphaned ack in: the verb now fails with the finding
+        with open(wal, "a") as fh:
+            fh.write(json.dumps({"t": "qa", "q": "q", "c": "c",
+                                 "i": 9}) + "\n")
+        rc = cli_main(["--wal", wal, "wal", "fsck"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert "orphaned-ack" in [f["code"] for f in out["findings"]]
+
+
+class TestSignalDedupRecovery:
+    def test_redelivered_request_id_noops_after_recovery(self, wal):
+        """A cross-cluster/client signal redelivered AFTER crash recovery
+        must not append a duplicate event: the request id rides the
+        WorkflowExecutionSignaled event and replay repopulates the dedup
+        set (ADVICE r5)."""
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "sig-wf", "t", TL)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        box.frontend.signal_workflow_execution(DOMAIN, "sig-wf", "go",
+                                               request_id="rid-1")
+        # same-process duplicate already no-ops
+        box.frontend.signal_workflow_execution(DOMAIN, "sig-wf", "go",
+                                               request_id="rid-1")
+        run_id = box.stores.execution.get_current_run_id(domain_id,
+                                                         "sig-wf")
+        live = box.stores.execution.get_workflow(domain_id, "sig-wf",
+                                                 run_id)
+        assert live.execution_info.signal_count == 1
+        box.stores.wal.close()
+        del box
+
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        assert report.ok
+        rebuilt = stores.execution.get_workflow(domain_id, "sig-wf",
+                                                run_id)
+        assert "rid-1" in rebuilt.signal_requested_ids
+        box2 = Onebox(num_hosts=1, num_shards=2, stores=stores)
+        box2.frontend.signal_workflow_execution(DOMAIN, "sig-wf", "go",
+                                                request_id="rid-1")
+        after = stores.execution.get_workflow(domain_id, "sig-wf", run_id)
+        assert after.execution_info.signal_count == 1  # still deduped
+        box2.frontend.signal_workflow_execution(DOMAIN, "sig-wf", "go",
+                                                request_id="rid-2")
+        after = stores.execution.get_workflow(domain_id, "sig-wf", run_id)
+        assert after.execution_info.signal_count == 2  # fresh ids apply
+
+    def test_dedup_set_replicates_to_standby(self):
+        """The request id crosses the replication stream too: a standby's
+        rebuilt state carries the dedup set, so promotion + redelivery
+        stays a no-op."""
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+        clusters = ReplicatedClusters(num_hosts=1, num_shards=2)
+        clusters.register_global_domain(DOMAIN)
+        clusters.active.frontend.start_workflow_execution(
+            DOMAIN, "rep-wf", "t", TL)
+        clusters.active.frontend.signal_workflow_execution(
+            DOMAIN, "rep-wf", "go", request_id="xdc-1")
+        clusters.replicate()
+        domain_id = clusters.standby.stores.domain.by_name(
+            DOMAIN).domain_id
+        run_id = clusters.standby.stores.execution.get_current_run_id(
+            domain_id, "rep-wf")
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "rep-wf", run_id)
+        assert "xdc-1" in standby_ms.signal_requested_ids
+
+
+class TestHistorySizeRecovery:
+    def test_history_size_rebuilt_from_blob_sizes(self, wal):
+        box = Onebox(num_hosts=1, num_shards=2,
+                     stores=open_durable_stores(wal))
+        box.frontend.register_domain(DOMAIN)
+        box.frontend.start_workflow_execution(DOMAIN, "hs-wf", "t", TL)
+        domain_id = box.frontend.describe_domain(DOMAIN).domain_id
+        for name in ("a", "b", "c"):
+            box.frontend.signal_workflow_execution(DOMAIN, "hs-wf", name)
+        run_id = box.stores.execution.get_current_run_id(domain_id,
+                                                         "hs-wf")
+        live = box.stores.execution.get_workflow(domain_id, "hs-wf",
+                                                 run_id)
+        assert live.history_size > 0
+        box.stores.wal.close()
+        del box
+        stores, report = recover_stores(wal, verify_on_device=False,
+                                        rebuild_on_device=False)
+        assert report.ok
+        rebuilt = stores.execution.get_workflow(domain_id, "hs-wf", run_id)
+        assert rebuilt.history_size == live.history_size
+        _zero_findings(wal, stores)
+
+
+class TestPurgeAckRecovery:
+    def test_purged_queue_acks_dropped_and_stay_dropped(self, wal):
+        """Items re-enqueued after a purge must never be skipped by a
+        consumer resuming from a pre-purge ack level — live, and after
+        recovery replays the purge record (ADVICE r5)."""
+        from cadence_tpu.engine.domainrepl import DomainReplicationTask
+        stores = open_durable_stores(wal)
+        task = DomainReplicationTask(
+            domain_id="d", name="n", retention_days=1,
+            active_cluster="primary", clusters=("primary",),
+            failover_version=0, notification_version=0, status=0,
+            description="", history_archival_uri="")
+        stores.queue.enqueue("dlq", task)
+        stores.queue.enqueue("dlq", task)
+        stores.queue.set_ack("dlq", "worker", 1)
+        assert stores.queue.get_ack("dlq", "worker") == 2
+        stores.queue.purge("dlq")
+        assert stores.queue.get_ack("dlq", "worker") == 0  # live reset
+        stores.queue.enqueue("dlq", task)
+        assert stores.queue.read(
+            "dlq", stores.queue.get_ack("dlq", "worker"))  # visible again
+        stores.wal.close()
+
+        recovered, _ = recover_stores(wal, verify_on_device=False,
+                                      rebuild_on_device=False)
+        assert recovered.queue.size("dlq") == 1
+        assert recovered.queue.get_ack("dlq", "worker") == 0
+        assert recovered.queue.read(
+            "dlq", recovered.queue.get_ack("dlq", "worker"))
+        _zero_findings(wal, recovered)

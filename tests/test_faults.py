@@ -4,10 +4,16 @@ Reference: persistenceErrorInjectionClients.go:51-101 — every manager
 wrapped with configurable error injection; callers' retry semantics get
 exercised against REAL mid-transaction failures, and the scanner detects
 what a torn write leaves behind.
-"""
+
+Every faulted cluster here is DURABLE, parametrized over both open_log
+backends (the `wal` fixture): injected faults raise before the target
+store method runs, so the WAL must stay consistent through the whole
+soak — each test's teardown recovers it and requires a clean fsck, which
+is the crash/fault/recovery matrix meeting the fault injector."""
 import pytest
 
 from cadence_tpu.core.enums import CloseStatus
+from cadence_tpu.engine.durability import open_durable_stores, recover_stores
 from cadence_tpu.engine.faults import (
     FaultInjector,
     TransientStoreError,
@@ -21,19 +27,34 @@ from tests.taskpoller import TaskPoller
 DOMAIN = "fault-domain"
 TL = "fault-tl"
 
+# the dual-backend `wal` fixture lives in tests/conftest.py
 
-def make_box(injector=None):
-    box = Onebox(num_hosts=1, num_shards=4)
+
+def make_box(injector=None, wal=None):
+    stores = open_durable_stores(wal) if wal else None
+    box = Onebox(num_hosts=1, num_shards=4, stores=stores)
     if injector is not None:
         inject_faults(box.stores, injector, metrics=box.metrics)
     box.frontend.register_domain(DOMAIN)
     return box
 
 
+def assert_recovers_clean(wal):
+    """Post-soak gate: the WAL the faulted cluster leaves behind recovers
+    with zero divergence and zero fsck findings."""
+    from cadence_tpu.engine import walcheck
+    stores, report = recover_stores(wal, verify_on_device=False,
+                                    rebuild_on_device=False)
+    assert report.ok, report.divergent
+    findings = (walcheck.audit_records(walcheck.read_raw_lines(wal))
+                + walcheck.audit_stores(stores))
+    assert findings == [], [f.as_dict() for f in findings]
+
+
 class TestScriptedFaults:
-    def test_failed_create_leaves_no_state_and_retry_succeeds(self):
+    def test_failed_create_leaves_no_state_and_retry_succeeds(self, wal):
         injector = FaultInjector()
-        box = make_box(injector)
+        box = make_box(injector, wal)
         injector.fail_next("execution", "create_workflow")
         with pytest.raises(TransientStoreError):
             box.frontend.start_workflow_execution(DOMAIN, "f-1", "t", TL)
@@ -44,13 +65,14 @@ class TestScriptedFaults:
         box.frontend.start_workflow_execution(DOMAIN, "f-1", "t", TL)
         TaskPoller(box, DOMAIN, TL, {"f-1": CompleteDecider()}).drain()
         assert box.tpu.verify_all().ok
+        assert_recovers_clean(wal)
 
-    def test_failed_update_mid_transaction_is_clean(self):
+    def test_failed_update_mid_transaction_is_clean(self, wal):
         """An injected failure at the commit point leaves committed STATE
         untouched; the retried request overwrites the torn history tail
         and lands cleanly."""
         injector = FaultInjector()
-        box = make_box(injector)
+        box = make_box(injector, wal)
         box.frontend.start_workflow_execution(DOMAIN, "f-2", "signal", TL)
         injector.fail_next("execution", "update_workflow")
         with pytest.raises(TransientStoreError):
@@ -63,15 +85,16 @@ class TestScriptedFaults:
         ms = box.stores.execution.get_workflow(domain_id, "f-2", run_id)
         assert ms.execution_info.signal_count == 1
         assert box.tpu.verify_all().ok
+        assert_recovers_clean(wal)
 
-    def test_torn_tail_detected_then_healed_by_retry(self):
+    def test_torn_tail_detected_then_healed_by_retry(self, wal):
         """A fault at the COMMIT POINT (the conditional state update, last
         write of a transaction) leaves an orphan history tail — the
         scanner's device-replay invariant flags it, and the caller's retry
         OVERWRITES the tail (append node-overwrite semantics) and commits,
         after which the cluster verifies clean."""
         injector = FaultInjector()
-        box = make_box(injector)
+        box = make_box(injector, wal)
         box.frontend.start_workflow_execution(DOMAIN, "f-3", "signal", TL)
         injector.fail_next("execution", "update_workflow")
         with pytest.raises(TransientStoreError):
@@ -82,6 +105,7 @@ class TestScriptedFaults:
         # retry heals: same event ids rewrite the torn tail, then commit
         box.frontend.signal_workflow_execution(DOMAIN, "f-3", "sig")
         assert box.scanner.run_once().ok
+        assert_recovers_clean(wal)
 
     def test_injected_faults_counted_in_metrics(self):
         injector = FaultInjector()
@@ -94,12 +118,12 @@ class TestScriptedFaults:
 
 
 class TestRateFaults:
-    def test_workload_survives_random_write_faults_with_retries(self):
+    def test_workload_survives_random_write_faults_with_retries(self, wal):
         """10% write-failure rate; a client-side retry tier (the reference
         wraps every service client in retryable decorators) pushes every
         workflow to completion and the cluster verifies clean."""
         injector = FaultInjector(rate=0.1, seed=42)
-        box = make_box(injector)
+        box = make_box(injector, wal)
 
         from cadence_tpu.engine.persistence import WorkflowAlreadyStartedError
 
@@ -151,6 +175,7 @@ class TestRateFaults:
                 done += 1
         assert done == 6
         assert box.tpu.verify_all().ok
+        assert_recovers_clean(wal)
 
 
 class TestMetricsDecorator:

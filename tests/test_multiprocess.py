@@ -172,50 +172,99 @@ class TestWireApiSurface:
 
 
 class TestWireAuth:
-    """The wire trust boundary is enforced: a peer without the cluster
-    secret is dropped before any frame is unpickled (advisor r4)."""
+    """The wire trust boundary is enforced: every connection opens with a
+    server nonce challenge; a peer that cannot answer
+    HMAC(secret, nonce || ctx) — or, while the legacy fallback is allowed,
+    the static preamble — is dropped before any frame is unpickled
+    (advisor r4; replay hardening this round)."""
+
+    @staticmethod
+    def _recv_after_handshake(sock):
+        """Bytes the server sends AFTER its 32-byte nonce challenge
+        (b"" = the connection was dropped without a response frame)."""
+        nonce = b""
+        while len(nonce) < 32:
+            chunk = sock.recv(32 - len(nonce))
+            if not chunk:
+                return b""
+            nonce += chunk
+        sock.settimeout(2)
+        try:
+            return sock.recv(1024)
+        except (TimeoutError, OSError):
+            return b""
 
     def test_unauthenticated_peer_is_rejected(self):
+        import pickle
         import socket
         import struct
+        import threading
 
         from cadence_tpu.engine.persistence import Stores
         from cadence_tpu.rpc.storeserver import StoreServer
         from cadence_tpu.rpc.wire import call
 
+        server = StoreServer(("127.0.0.1", 0), Stores())
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            addr = ("127.0.0.1", server.server_address[1])
+            # authenticated challenge-response path works
+            assert call(addr, ("ping",)) == "pong"
+            # raw connection ignoring the challenge, garbage response: a
+            # pickle frame is never processed — dropped, no response frame
+            with socket.create_connection(addr, timeout=5) as sock:
+                body = b"garbage-no-hello"
+                sock.sendall(struct.pack(">I", len(body)) + body)
+                assert self._recv_after_handshake(sock) == b""
+            # wrong secret: a forged 32-byte response + a well-formed
+            # frame is dropped without a response
+            with socket.create_connection(addr, timeout=5) as sock:
+                sock.sendall(b"\x00" * 32)
+                body = pickle.dumps(("ping",))
+                sock.sendall(struct.pack(">I", len(body)) + body)
+                assert self._recv_after_handshake(sock) == b""
+            assert call(addr, ("ping",)) == "pong"
+        finally:
+            server.shutdown()
+
+    def test_challenge_response_blocks_replay(self, monkeypatch):
+        """A captured handshake response must be useless on the NEXT
+        connection (fresh nonce); the static legacy preamble is accepted
+        only while CADENCE_TPU_WIRE_ALLOW_STATIC permits it."""
+        import pickle
+        import socket
+        import struct
         import threading
+
+        from cadence_tpu.engine.persistence import Stores
+        from cadence_tpu.rpc.storeserver import StoreServer
+        from cadence_tpu.rpc.wire import _challenge_mac, _hello_mac, call
 
         server = StoreServer(("127.0.0.1", 0), Stores())
         threading.Thread(target=server.serve_forever, daemon=True).start()
         try:
             addr = ("127.0.0.1", server.server_address[1])
-            # authenticated path works
-            assert call(addr, ("ping",)) == "pong"
-            # raw connection with NO preamble: a pickle frame is never
-            # processed — the server hangs up instead of answering
+            body = pickle.dumps(("ping",))
+            frame = struct.pack(">I", len(body)) + body
+            # legacy static preamble: accepted under the default fallback
             with socket.create_connection(addr, timeout=5) as sock:
-                body = b"garbage-no-hello"
-                sock.sendall(struct.pack(">I", len(body)) + body)
-                sock.settimeout(2)
-                try:
-                    data = sock.recv(1024)
-                except (TimeoutError, OSError):
-                    data = b""
-                assert data == b""  # dropped, no response frame
-            # wrong secret: a forged 32-byte preamble + a well-formed
-            # frame is dropped without a response
-            import pickle
-
+                sock.recv(32)  # a legacy client ignores the challenge
+                sock.sendall(_hello_mac() + frame)
+                kind, payload = pickle.loads(sock.recv(4096)[4:])
+                assert (kind, payload) == ("ok", "pong")
+            monkeypatch.setenv("CADENCE_TPU_WIRE_ALLOW_STATIC", "0")
+            # replay: a valid response for connection A fails on B
+            with socket.create_connection(addr, timeout=5) as first:
+                nonce = first.recv(32)
+                captured = _challenge_mac(nonce)
             with socket.create_connection(addr, timeout=5) as sock:
-                sock.sendall(b"\x00" * 32)
-                body = pickle.dumps(("ping",))
-                sock.sendall(struct.pack(">I", len(body)) + body)
-                sock.settimeout(2)
-                try:
-                    data = sock.recv(1024)
-                except (TimeoutError, OSError):
-                    data = b""
-                assert data == b""
+                sock.sendall(captured + frame)  # stale nonce's MAC
+                assert self._recv_after_handshake(sock) == b""
+            # legacy preamble: rejected once the fallback is disabled
+            with socket.create_connection(addr, timeout=5) as sock:
+                sock.sendall(_hello_mac() + frame)
+                assert self._recv_after_handshake(sock) == b""
+            # the real client still authenticates
             assert call(addr, ("ping",)) == "pong"
         finally:
             server.shutdown()

@@ -245,6 +245,36 @@ class TestCircuitBreaker:
         finally:
             server.shutdown()
 
+    def test_budget_exhausted_timeout_not_charged_to_breaker(self):
+        """A socket timeout caused by the CALLER's nearly-spent deadline
+        (wire.effective_timeout clamps the socket timeout to the remaining
+        budget) is the caller's problem, not the peer's: a healthy target
+        at normal latency must not have its breaker opened by a few
+        tight-deadline callers."""
+        import time as _time
+
+        stores_bundle = Stores()
+
+        def slowish(*args, **kwargs):
+            _time.sleep(0.2)  # normal latency, far beyond a 1ms budget
+            return 0
+
+        stores_bundle.queue.size = slowish
+        server, port = start_store_server(stores=stores_bundle)
+        try:
+            registry = MetricsRegistry()
+            breakers = BreakerRegistry(metrics=registry, failure_threshold=1)
+            remote = RemoteStores(("127.0.0.1", port), metrics=registry,
+                                  breakers=breakers)
+            assert remote.ping() == "pong"
+            with deadline_mod.bind(Deadline.after(0.05)):
+                with pytest.raises((OSError, DeadlineExceeded)):
+                    remote.queue.size("q")
+            assert breakers.for_target(("127.0.0.1", port)).state() == CLOSED
+            assert remote.ping() == "pong"  # still served, not shed
+        finally:
+            server.shutdown()
+
     def test_registry_emits_state_gauge_and_transitions(self):
         registry = MetricsRegistry()
         breakers = BreakerRegistry(metrics=registry, failure_threshold=1,
