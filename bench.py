@@ -79,11 +79,54 @@ def _hbm_peak(compiled) -> dict:
         return {"hbm_peak_bytes": None, "hbm_source": "unavailable"}
 
 
+def _pipelined_transfer(corpus, mesh, layout, n_chunks: int, depth: int):
+    """Stream a pre-packed wirec corpus through the bulk executor in W
+    chunks: the H2D copy of chunk N+1 overlaps the replay of chunk N, so
+    the transfer-included rate approaches the resident kernel rate
+    instead of serializing link + compute. Pack cost is zero by design —
+    the chunks come pre-packed, the warm pack-cache configuration of the
+    production path (engine/cache.PackCache)."""
+    from cadence_tpu.engine.executor import BulkReplayExecutor
+    from cadence_tpu.ops.wirec import WirecCorpus
+    from cadence_tpu.parallel.mesh import (
+        _replay_wirec_crc_with_stats,
+        shard_wirec,
+    )
+
+    W = corpus.slab.shape[0]
+    step = W // n_chunks
+    chunks = [WirecCorpus(corpus.slab[lo:lo + step],
+                          corpus.bases[lo:lo + step],
+                          corpus.n_events[lo:lo + step], corpus.profile)
+              for lo in range(0, W, step)]
+    executor = BulkReplayExecutor(depth=depth)
+
+    def run_once():
+        def pack(ci):
+            return chunks[ci]
+
+        def launch(ci, c):
+            parts = shard_wirec(c, mesh)
+            return _replay_wirec_crc_with_stats(*parts, c.profile, layout)
+
+        def consume(ci, outs):
+            crc, errors, _ = outs
+            return (np.asarray(crc).astype(np.uint32), np.asarray(errors))
+
+        results, _rep = executor.run(len(chunks), pack, launch, consume)
+        return (np.concatenate([c for c, _ in results]),
+                np.concatenate([e for _, e in results]))
+
+    return run_once
+
+
 def _suite_table(trials: int, suite_workflows: int, layout):
     """Host-encoded corpora (the product's replay configuration): distinct
     histories, wirec-compressed lanes (~10-18 B/event, ops/wirec.py)
     decoded on device, replay + checksum on device, 4B/wf pulled. The
-    wire32 transfer rate is kept as the uncompressed comparison point."""
+    transfer-included rate streams the corpus through the pipelined bulk
+    executor (chunked H2D overlapping the kernel); the one-shot rate and
+    the wire32 rate are kept as comparison points."""
     import jax
 
     from cadence_tpu.gen.corpus import SUITES, generate_corpus
@@ -99,6 +142,8 @@ def _suite_table(trials: int, suite_workflows: int, layout):
 
     mesh = make_mesh()
     n_devices = jax.device_count()
+    pack_threads = os.cpu_count() or 1
+    pipeline_depth = 3
     table = {}
     for suite in SUITES:
         histories = generate_corpus(suite, num_workflows=suite_workflows,
@@ -106,7 +151,8 @@ def _suite_table(trials: int, suite_workflows: int, layout):
         events_np = encode_corpus(histories)
         real = int((events_np[:, :, LANE_EVENT_ID] > 0).sum())
         t0 = time.perf_counter()
-        corpus = pack_wirec(events_np)
+        # chunk-parallel host pack: scales with cores, identical bytes
+        corpus = pack_wirec(events_np, num_threads=pack_threads)
         t_pack = time.perf_counter() - t0
         wire = to_wire32(events_np)
 
@@ -123,9 +169,23 @@ def _suite_table(trials: int, suite_workflows: int, layout):
             t0 = time.perf_counter()
             run_resident(parts)
             rates.append(real / (time.perf_counter() - t0) / n_devices)
-        # transfer-inclusive: the SAME replay with the H2D copy of the
-        # COMPRESSED corpus timed. On tunneled hosts this measures the
-        # link, and says so — wirec's whole point is shrinking this leg.
+        # transfer-inclusive, PIPELINED: the corpus streams through the
+        # bulk executor in chunks, each chunk's H2D overlapping the
+        # previous chunk's kernel. On tunneled hosts the link is still
+        # the floor — but it now hides behind compute instead of adding
+        # to it. The chunk count must divide W and keep shards whole.
+        n_chunks = next(nc for nc in (4, 2, 1)
+                        if suite_workflows % nc == 0
+                        and (suite_workflows // nc) % n_devices == 0)
+        run_pipelined = _pipelined_transfer(corpus, mesh, layout, n_chunks,
+                                            pipeline_depth)
+        crc_p, err_p = run_pipelined()  # compile + warm (same executable)
+        xfer_rates = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            run_pipelined()
+            xfer_rates.append(real / (time.perf_counter() - t0) / n_devices)
+        # one-shot comparison: the r05 configuration (single H2D + launch)
         t0 = time.perf_counter()
         crc_x, err_x, _ = replay_wirec_sharded_crc(corpus, mesh, layout)
         np.asarray(crc_x)
@@ -143,18 +203,27 @@ def _suite_table(trials: int, suite_workflows: int, layout):
             "wire_format": "wirec",
             "bytes_per_event": round(corpus.bytes_per_event(), 2),
             "pack_s": round(t_pack, 3),
+            "pack_threads": pack_threads,
             "rate_min": round(min(rates)),
             "rate_median": round(statistics.median(rates)),
             "rate_max": round(max(rates)),
-            "transfer_included_rate": round(real / t_xfer / n_devices),
+            "transfer_included_rate": round(
+                statistics.median(xfer_rates)),
+            "transfer_included_rate_min": round(min(xfer_rates)),
+            "transfer_included_rate_oneshot": round(real / t_xfer / n_devices),
             "transfer_included_rate_wire32": round(
                 real / t_xfer32 / n_devices),
+            "transfer_chunks": n_chunks,
+            "pipeline_depth": pipeline_depth,
             "h2d_bytes": int(corpus.wire_bytes),
             "h2d_bytes_wire32": int(wire.nbytes),
             "error_workflows": int((errors != 0).sum()),
             "crc_xor": int(np.bitwise_xor.reduce(crcs.astype(np.uint32))),
             "crc_parity_wire32": bool(
                 (crc_w == crcs.astype(np.uint32)).all()),
+            "crc_parity_pipelined": bool(
+                (crc_p == crcs.astype(np.uint32)).all()
+                and (err_p == errors).all()),
         }
     return table
 
@@ -381,6 +450,8 @@ def _feeder_rate(layout):
         "compress_s": round(report.compress_s, 3),
         "bytes_per_event": round(report.bytes_per_event, 2),
         "profile_refits": report.profile_refits,
+        "pipeline_depth": report.depth,
+        "pack_queue_wait_s": round(report.pack_queue_wait_s, 3),
         "error_workflows": int((errors != 0).sum()),
         "wire32_sustained_events_per_sec": round(report32.events_per_sec),
         "wire32_error_workflows": int((errors32 != 0).sum()),

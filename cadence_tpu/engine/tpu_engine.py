@@ -15,15 +15,28 @@ Reference seams it occupies:
 - the mutable-state checksum (execution/checksum.go:36) is the comparison
   oracle on both sides.
 
+The hot path runs on the pipelined bulk-replay executor
+(engine/executor.py): keys are CHUNKED (bounding peak host+HBM footprint —
+one long-tail history no longer sizes the whole corpus), host packing of
+chunk N+1 overlaps the device replay of chunk N, per-workflow encoded
+lanes come from the content-addressed pack cache (engine/cache.PackCache —
+a warm re-verify of an unchanged corpus skips repacking entirely; an
+appended batch repacks only the suffix), and verify_all compares payload
+rows ON DEVICE, reading back a mismatch bitmap plus the error lanes
+instead of the full [W, width] tensor.
+
 Workflows whose histories exceed kernel capacities (pending tables, event
 length) or trip the error flag fall back to the per-workflow oracle path —
 measured and reported, never silent.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.checksum import (
@@ -33,7 +46,33 @@ from ..core.checksum import (
     payload_row,
 )
 from ..oracle.state_builder import StateBuilder
+from ..ops.encode import (
+    LANE_EVENT_ID,
+    LANE_EVENT_TYPE,
+    NUM_LANES,
+    assemble_corpus,
+    encode_segments,
+)
+from ..ops.payload import payload_rows
+from ..ops.replay import replay_events, verify_rows
+from ..utils import metrics as m
+from ..utils.profiler import ReplayProfiler
+from .cache import PackCache
+from .executor import BulkReplayExecutor
 from .persistence import Stores
+
+#: max workflows per device launch on the bulk path; bounds peak host
+#: corpus bytes and HBM per chunk (the regression the chunked executor
+#: fixes: one [W, E_max, L] corpus sized by the longest history)
+CHUNK_ENV = "CADENCE_TPU_REPLAY_CHUNK"
+DEFAULT_CHUNK = 4096
+
+
+def _bucket_events(n: int) -> int:
+    """Round the chunk's event axis up to a power of two (min 16): chunks
+    with similar histories share one compiled executable instead of one
+    per exact max length, and padding rows are no-ops in the kernel."""
+    return max(16, 1 << (max(1, int(n)) - 1).bit_length())
 
 
 @dataclass
@@ -53,11 +92,34 @@ class TPUReplayEngine:
     """Bulk device replay over persisted histories."""
 
     def __init__(self, stores: Stores,
-                 layout: PayloadLayout = DEFAULT_LAYOUT) -> None:
-        from ..utils.metrics import DEFAULT_REGISTRY
+                 layout: PayloadLayout = DEFAULT_LAYOUT,
+                 chunk_workflows: Optional[int] = None,
+                 pipeline_depth: Optional[int] = None) -> None:
         self.stores = stores
         self.layout = layout
-        self.metrics = DEFAULT_REGISTRY
+        self.pack_cache = PackCache()
+        self.metrics = m.DEFAULT_REGISTRY
+        self.chunk_workflows = (chunk_workflows if chunk_workflows
+                                else int(os.environ.get(CHUNK_ENV,
+                                                        str(DEFAULT_CHUNK))))
+        self.pipeline_depth = pipeline_depth
+        #: (W, E) of each chunk of the last bulk run — the test seam for
+        #: the bounded-footprint contract (a long-tail history inflates
+        #: only its own chunk's E)
+        self.last_run_chunk_shapes: List[Tuple[int, int]] = []
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, registry) -> None:
+        """Clusters wire their own registry post-construction (Onebox/
+        ServiceHost set `tpu.metrics = ...`); the pack cache's hit/miss
+        counters must land on the SAME registry or they never reach that
+        cluster's /metrics scrape."""
+        self._metrics = registry
+        self.pack_cache.metrics = registry
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [
@@ -98,83 +160,198 @@ class TPUReplayEngine:
             ))
         return segments
 
-    def replay_tree_payloads(self, keys: Sequence[Tuple[str, str, str]]
-                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Device-replay full branch trees (divergent histories included);
-        returns (payload rows, errors, device-chosen current branch).
+    def _encode_key_rows(self, key: Tuple[str, str, str]) -> np.ndarray:
+        """One workflow's encoded [n, L] lane rows. Single-lineage
+        histories go through the content-addressed pack cache (append-only
+        ⇒ a warm re-verify reuses the rows; an appended batch packs only
+        the suffix); multi-branch trees — post-conflict-resolution shapes
+        that are not append-only in the cached sense — encode fresh."""
+        hs = self.stores.history
+        if hs.branch_count(*key) <= 1 and hs.get_current_branch(*key) == 0:
+            return self.pack_cache.encode(
+                key, hs.as_history_batches(*key))
+        segs = self.tree_segments(key)
+        total = sum(len(b.events) for seg in segs for b in seg[0])
+        return encode_segments(segs, total)
 
-        Each launch is decomposed into pack/h2d/kernel/readback legs by a
-        ReplayProfiler, so the end-to-end latency timer can be diffed
-        leg-by-leg from any scrape."""
-        import jax
-        import jax.numpy as jnp
+    def _chunk_spans(self, n: int) -> List[Tuple[int, int]]:
+        c = max(1, self.chunk_workflows)
+        return [(lo, min(lo + c, n)) for lo in range(0, n, c)]
 
-        from ..ops.encode import encode_segment_corpus
-        from ..ops.payload import payload_rows
-        from ..ops.replay import replay_events
+    def _pack_chunk(self, keys: Sequence[Tuple[str, str, str]],
+                    pad_to: int) -> np.ndarray:
+        """Encode one chunk of keys into [pad_to, E, L]; E is the pow2
+        bucket of THIS chunk's longest history, not the corpus-wide max —
+        the bounded-memory contract. Pad workflows are all-padding rows
+        (the kernel no-ops them)."""
+        rows_list = [self._encode_key_rows(k) for k in keys]
+        E = _bucket_events(max((r.shape[0] for r in rows_list), default=1))
+        corpus = assemble_corpus(rows_list, E)
+        if corpus.shape[0] < pad_to:
+            pad = np.zeros((pad_to - corpus.shape[0], E, NUM_LANES),
+                           dtype=np.int64)
+            pad[:, :, LANE_EVENT_TYPE] = -1
+            corpus = np.concatenate([corpus, pad])
+        return corpus
 
-        from ..utils import metrics as m
-        from ..utils.profiler import ReplayProfiler
-        scope = self.metrics.scope(m.SCOPE_TPU_REPLAY)
+    def _run_chunks(self, keys: List[Tuple[str, str, str]], pack_extra,
+                    launch_fn, readback_fn):
+        """Drive the pipelined executor over key chunks.
+
+        pack_extra(chunk_keys) -> host-side extras packed alongside the
+        corpus (runs in the pack pool, overlapped with device compute);
+        launch_fn(corpus_dev, extras) -> device outs (async);
+        readback_fn(outs) -> numpy results per chunk.
+        Returns (per-chunk results, per-chunk real-event counts)."""
+        spans = self._chunk_spans(len(keys))
+        pad_to = min(max(1, self.chunk_workflows), len(keys))
         prof = ReplayProfiler(self.metrics)
-        with prof.leg(m.M_PROFILE_PACK):
-            corpus = encode_segment_corpus(
-                [self.tree_segments(k) for k in keys])
-        real_events = int((corpus[:, :, 0] > 0).sum())
-        scope.inc(m.M_KERNEL_LAUNCHES)
-        scope.inc(m.M_EVENTS_REPLAYED, real_events)
-        with scope.timed():
+        scope = self.metrics.scope(m.SCOPE_TPU_REPLAY)
+        executor = BulkReplayExecutor(depth=self.pipeline_depth,
+                                      registry=self.metrics)
+        shapes: List[Optional[Tuple[int, int]]] = [None] * len(spans)
+        events: List[int] = [0] * len(spans)
+
+        def pack(ci):
+            lo, hi = spans[ci]
+            chunk_keys = keys[lo:hi]
+            corpus = self._pack_chunk(chunk_keys, pad_to)
+            shapes[ci] = (corpus.shape[0], corpus.shape[1])
+            events[ci] = int((corpus[:, :, LANE_EVENT_ID] > 0).sum())
+            extras = pack_extra(chunk_keys) if pack_extra else None
+            return corpus, extras
+
+        def launch(ci, packed):
+            corpus, extras = packed
+            scope.inc(m.M_KERNEL_LAUNCHES)
+            scope.inc(m.M_EVENTS_REPLAYED, events[ci])
             with prof.leg(m.M_PROFILE_H2D):
-                device_corpus = jax.device_put(jnp.asarray(corpus))
+                corpus_dev = jax.device_put(jnp.asarray(corpus))
                 prof.h2d(corpus.nbytes)
+            return launch_fn(corpus_dev, extras)
+
+        def consume(ci, outs):
             with prof.leg(m.M_PROFILE_KERNEL):
-                state = replay_events(device_corpus, self.layout)
-                rows_dev = payload_rows(state, self.layout)
-                jax.block_until_ready(rows_dev)
+                jax.block_until_ready(outs)
             with prof.leg(m.M_PROFILE_READBACK):
-                rows = np.asarray(rows_dev)
-                errors = np.asarray(state.error)
+                return readback_fn(outs)
+
+        with scope.timed():
+            results, _report = executor.run(len(spans), pack, launch,
+                                            consume)
+        self.last_run_chunk_shapes = [s for s in shapes if s is not None]
         t = self.metrics.timer(m.SCOPE_TPU_REPLAY, m.M_LATENCY)
         if t.total_s > 0:
             self.metrics.gauge(
                 m.SCOPE_TPU_REPLAY, m.M_REPLAY_THROUGHPUT,
                 self.metrics.counter(m.SCOPE_TPU_REPLAY, m.M_EVENTS_REPLAYED)
                 / t.total_s)
-        return (rows, errors, np.asarray(state.current_branch))
+        return results, spans
+
+    def replay_tree_payloads(self, keys: Sequence[Tuple[str, str, str]]
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Device-replay full branch trees (divergent histories included);
+        returns (payload rows, errors, device-chosen current branch).
+
+        Chunked through the bulk executor: host packing overlaps device
+        replay, each chunk's event axis is sized to ITS longest history
+        (one long-tail workflow no longer inflates the whole corpus), and
+        every launch is decomposed into pack/pack-queue-wait/h2d/kernel/
+        readback legs so scrapes show which pipeline side is starving."""
+        keys = list(keys)
+        if not keys:
+            width = self.layout.width
+            return (np.zeros((0, width), dtype=np.int64),
+                    np.zeros((0,), dtype=np.int32),
+                    np.zeros((0,), dtype=np.int32))
+
+        def launch(corpus_dev, _extras):
+            state = replay_events(corpus_dev, self.layout)
+            return (payload_rows(state, self.layout), state.error,
+                    state.current_branch)
+
+        def readback(outs):
+            rows_dev, err_dev, branch_dev = outs
+            return (np.asarray(rows_dev), np.asarray(err_dev),
+                    np.asarray(branch_dev))
+
+        results, spans = self._run_chunks(keys, None, launch, readback)
+        rows = np.concatenate([r[0][:hi - lo]
+                               for r, (lo, hi) in zip(results, spans)])
+        errors = np.concatenate([r[1][:hi - lo]
+                                 for r, (lo, hi) in zip(results, spans)])
+        branch = np.concatenate([r[2][:hi - lo]
+                                 for r, (lo, hi) in zip(results, spans)])
+        return rows, errors, branch
 
     def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
                    ) -> BulkVerifyResult:
         """Replay persisted histories on device and compare against the live
-        mutable states (zero-divergence contract). Errored rows are re-run
-        through the oracle (per-workflow fallback path)."""
+        mutable states (zero-divergence contract). The compare itself runs
+        ON DEVICE: expected payload rows ship with the corpus and the host
+        reads back a mismatch bitmap plus the error lanes — not the full
+        [W, width] payload tensor. Errored rows are re-run through the
+        oracle (per-workflow fallback path), exactly as before."""
         if keys is None:
             keys = self.stores.execution.list_executions()
         keys = list(keys)
         if not keys:
             return BulkVerifyResult(total=0, verified_on_device=0)
-        rows, errors, device_branch = self.replay_tree_payloads(keys)
+
+        def pack_extra(chunk_keys):
+            expected = np.zeros((len(chunk_keys), self.layout.width),
+                                dtype=np.int64)
+            exp_branch = np.zeros((len(chunk_keys),), dtype=np.int32)
+            for j, key in enumerate(chunk_keys):
+                live_ms = self.stores.execution.get_workflow(*key)
+                row = payload_row(live_ms, self.layout)
+                # sticky state is active-side only; replay clears it
+                # (STICKY_ROW_INDEX note in core/checksum.py)
+                row[STICKY_ROW_INDEX] = 0
+                expected[j] = row
+                exp_branch[j] = live_ms.version_histories.current_index
+            return expected, exp_branch
+
+        def launch(corpus_dev, extras):
+            expected, exp_branch = extras
+            W = int(corpus_dev.shape[0])
+            if W > expected.shape[0]:
+                # tail-chunk padding workflows: their bitmap entries are
+                # garbage but the result loop never reads past the real
+                # key count, so zero-filled expectations are fine
+                expected = np.concatenate([
+                    expected, np.zeros((W - expected.shape[0],
+                                        expected.shape[1]), np.int64)])
+                exp_branch = np.concatenate([
+                    exp_branch, np.zeros((W - exp_branch.shape[0],),
+                                         np.int32)])
+            state = replay_events(corpus_dev, self.layout)
+            rows_dev = payload_rows(state, self.layout)
+            mismatch = verify_rows(rows_dev, jnp.asarray(expected),
+                                   state.current_branch,
+                                   jnp.asarray(exp_branch))
+            return mismatch, state.error, expected
+
+        def readback(outs):
+            mismatch_dev, err_dev, expected = outs
+            return np.asarray(mismatch_dev), np.asarray(err_dev), expected
+
+        results, spans = self._run_chunks(keys, pack_extra, launch, readback)
 
         result = BulkVerifyResult(total=len(keys), verified_on_device=0)
-        for i, key in enumerate(keys):
-            live_ms = self.stores.execution.get_workflow(*key)
-            expected = payload_row(live_ms, self.layout)
-            # sticky state is active-side only; replay clears it
-            # (STICKY_ROW_INDEX note in core/checksum.py)
-            expected[STICKY_ROW_INDEX] = 0
-            if errors[i] != 0:
-                # device flagged this workflow: oracle fallback
-                result.device_errors.append((key, int(errors[i])))
-                result.fallback.append(key)
-                oracle_ms = StateBuilder().replay_history(
-                    self.stores.history.as_history_batches(*key))
-                if not (payload_row(oracle_ms, self.layout) == expected).all():
-                    result.divergent.append(key)
-            else:
-                result.verified_on_device += 1
-                if not (rows[i] == expected).all():
-                    result.divergent.append(key)
-                elif device_branch[i] != live_ms.version_histories.current_index:
-                    # device-side branch arbitration must agree with the
-                    # store's conflict-resolution outcome
-                    result.divergent.append(key)
+        for (lo, hi), (mismatch, errors, expected) in zip(spans, results):
+            for j, key in enumerate(keys[lo:hi]):
+                if errors[j] != 0:
+                    # device flagged this workflow: oracle fallback
+                    result.device_errors.append((key, int(errors[j])))
+                    result.fallback.append(key)
+                    oracle_ms = StateBuilder().replay_history(
+                        self.stores.history.as_history_batches(*key))
+                    if not (payload_row(oracle_ms, self.layout)
+                            == expected[j]).all():
+                        result.divergent.append(key)
+                else:
+                    result.verified_on_device += 1
+                    if mismatch[j]:
+                        result.divergent.append(key)
         return result

@@ -1,10 +1,14 @@
 """Pipelined feeder: wire bytes → C++ packer → device replay chunks.
 
 SURVEY §7 step 6 / §2.6 P7: the host must sustain the kernel's event rate,
-so packing and replay overlap — while the device replays workflow-chunk N
-(JAX async dispatch returns immediately), host threads pack chunk N+1 with
-the native packer into an alternating pair of preallocated buffers (no
-per-chunk allocation). Every chunk shares one [C, E, L] shape, so a single
+so packing and replay overlap. The pipeline itself is the shared bulk
+executor (engine/executor.py): a bounded pack THREAD POOL produces chunks
+up to `depth` ahead of the device consumer into a ring of preallocated
+buffers (no per-chunk allocation), the ring-slot reuse discipline blocks a
+packer until the chunk that last used its slot has fully replayed (the
+depth-2 discipline of the old double-buffer loop, generalized to depth N),
+and the consumer's `pack-queue-wait` profiler leg says which side of the
+pipeline is starving. Every chunk shares one [C, E, L] shape, so a single
 compiled executable serves the whole stream.
 
 The feeder is the production ingest path the bench and bulk-replay flows
@@ -13,13 +17,19 @@ packer's standalone rate so the pipeline's overhead is always measured.
 """
 from __future__ import annotations
 
+import os
 import time
+from concurrent.futures import Future
 from dataclasses import dataclass
+from threading import Lock
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
+from ..engine.executor import BulkReplayExecutor
+from ..utils import metrics as m
+from ..utils.profiler import ReplayProfiler
 from . import packing
 
 
@@ -30,6 +40,10 @@ class FeedReport:
     chunks: int = 0
     wall_s: float = 0.0
     pack_s: float = 0.0
+    #: pipeline shape + producer/consumer balance: time the device
+    #: consumer stalled waiting on the pack pool (engine/executor.py)
+    depth: int = 0
+    pack_queue_wait_s: float = 0.0
     #: wirec pipeline only: host compression cost and wire density
     compress_s: float = 0.0
     wire_bytes: int = 0
@@ -48,60 +62,69 @@ class FeedReport:
         return self.wire_bytes / self.events if self.events else 0.0
 
 
+#: serialized empty history (0 batches) — pads the tail chunk to the
+#: steady shape so one executable serves every chunk
+_EMPTY_BLOB = b"\x00\x00\x00\x00"
+
+
+def _chunk_blobs(blobs: Sequence[bytes], lo: int,
+                 chunk_workflows: int) -> List[bytes]:
+    chunk = list(blobs[lo:lo + chunk_workflows])
+    pad = chunk_workflows - len(chunk)
+    if pad:
+        chunk.extend([_EMPTY_BLOB] * pad)
+    return chunk
+
+
 def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
           layout: PayloadLayout, num_threads: Optional[int],
-          num_lanes: int, dtype, pack_fn, replay_fn
+          num_lanes: int, dtype, pack_fn, replay_fn,
+          depth: Optional[int] = None
           ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
-    """The pipelined feed loop, shared by the int64 and wire32 formats.
-
-    Bounded ring of pack buffers: pack into one while the device still
-    holds a transfer from another. Before REUSING a buffer, block until
-    the chunk that last used it has fully replayed — once its outputs
-    exist the input transfer has been consumed, so overwriting the host
-    buffer can no longer corrupt an in-flight H2D copy (this also bounds
-    the dispatch queue to `depth` chunks; unbounded async dispatch was a
-    real buffer-reuse race, VERDICT r3 weak #1)."""
+    """The pipelined feed loop, shared by the int64 and wire32 formats,
+    on the bulk executor: ring of `depth` pack buffers, pack pool runs
+    ahead of the device, a buffer is reused only after the chunk that
+    last used it has fully replayed (the depth-2 buffer-reuse race fix
+    of VERDICT r3 weak #1, generalized)."""
     import jax
 
     total = len(blobs)
-    report = FeedReport(workflows=total)
-    depth = 2
-    from ..utils import metrics as m
-    from ..utils.profiler import ReplayProfiler
-
+    executor = BulkReplayExecutor(depth=depth)
+    report = FeedReport(workflows=total, depth=executor.depth)
     prof = ReplayProfiler()
     buffers = [np.empty((chunk_workflows, max_events, num_lanes),
-                        dtype=dtype) for _ in range(depth)]
-    start = time.perf_counter()
-    device_outs: List[Tuple] = []
-    for ci, lo in enumerate(range(0, total, chunk_workflows)):
-        if ci >= depth:
-            # the wait for an in-flight chunk IS the kernel leg of the
-            # pipeline: any host time spent here is device-bound
-            with prof.leg(m.M_PROFILE_KERNEL):
-                jax.block_until_ready(device_outs[ci - depth])
-        chunk = list(blobs[lo:lo + chunk_workflows])
-        pad = chunk_workflows - len(chunk)
-        if pad:
-            chunk.extend([_EMPTY_BLOB] * pad)
-        t0 = time.perf_counter()
+                        dtype=dtype) for _ in range(executor.depth)]
+    n_chunks = -(-total // chunk_workflows) if total else 0
+    chunk_events = [0] * n_chunks
+
+    def pack(ci):
+        chunk = _chunk_blobs(blobs, ci * chunk_workflows, chunk_workflows)
         packed = pack_fn(chunk, max_events, num_threads=num_threads,
-                         out=buffers[ci % depth])
-        pack_dt = time.perf_counter() - t0
-        report.pack_s += pack_dt
-        prof.observe(m.M_PROFILE_PACK, pack_dt)
-        report.events += int((packed[:, :, 0] > 0).sum())
-        # async dispatch: the device crunches while the next chunk packs
+                         out=buffers[ci % executor.depth])
+        chunk_events[ci] = int((packed[:, :, 0] > 0).sum())
+        return packed
+
+    def launch(ci, packed):
+        # async dispatch: the device crunches while later chunks pack
         with prof.leg(m.M_PROFILE_H2D):
             device_chunk = jax.device_put(packed)
             prof.h2d(packed.nbytes)
-        device_outs.append(replay_fn(device_chunk, layout))
-        report.chunks += 1
-    with prof.leg(m.M_PROFILE_READBACK):
-        first = np.concatenate(
-            [np.asarray(r) for r, _ in device_outs])[:total]
-        errors = np.concatenate(
-            [np.asarray(e) for _, e in device_outs])[:total]
+        return replay_fn(device_chunk, layout)
+
+    def consume(ci, outs):
+        with prof.leg(m.M_PROFILE_KERNEL):
+            jax.block_until_ready(outs)
+        with prof.leg(m.M_PROFILE_READBACK):
+            return np.asarray(outs[0]), np.asarray(outs[1])
+
+    start = time.perf_counter()
+    results, prep = executor.run(n_chunks, pack, launch, consume)
+    first = np.concatenate([r for r, _ in results])[:total]
+    errors = np.concatenate([e for _, e in results])[:total]
+    report.chunks = prep.chunks
+    report.pack_s = prep.pack_s
+    report.pack_queue_wait_s = prep.pack_queue_wait_s
+    report.events = sum(chunk_events)
     report.wall_s = time.perf_counter() - start
     return first, errors, report
 
@@ -109,7 +132,8 @@ def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
 def feed_serialized(blobs: Sequence[bytes], max_events: int,
                     chunk_workflows: int = 4096,
                     layout: PayloadLayout = DEFAULT_LAYOUT,
-                    num_threads: Optional[int] = None
+                    num_threads: Optional[int] = None,
+                    depth: Optional[int] = None
                     ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Replay W serialized histories chunk-by-chunk; returns
     (payload rows [W, width], errors [W], FeedReport)."""
@@ -117,18 +141,14 @@ def feed_serialized(blobs: Sequence[bytes], max_events: int,
 
     return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
                  packing.NUM_LANES, np.int64, packing.pack_serialized,
-                 replay_to_payload)
-
-
-#: serialized empty history (0 batches) — pads the tail chunk to the
-#: steady shape so one executable serves every chunk
-_EMPTY_BLOB = b"\x00\x00\x00\x00"
+                 replay_to_payload, depth=depth)
 
 
 def feed_serialized32(blobs: Sequence[bytes], max_events: int,
                       chunk_workflows: int = 4096,
                       layout: PayloadLayout = DEFAULT_LAYOUT,
-                      num_threads: Optional[int] = None
+                      num_threads: Optional[int] = None,
+                      depth: Optional[int] = None
                       ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """The production ingest pipeline: wire bytes → C++ wire32 packer →
     int32 H2D (44% of the int64 bytes) → device replay+checksum → 4
@@ -138,13 +158,14 @@ def feed_serialized32(blobs: Sequence[bytes], max_events: int,
 
     return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
                  NUM_LANES32, np.int32, packing.pack_serialized32,
-                 replay_to_crc32)
+                 replay_to_crc32, depth=depth)
 
 
 def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
                           chunk_workflows: int = 4096,
                           layout: PayloadLayout = DEFAULT_LAYOUT,
-                          num_threads: Optional[int] = None
+                          num_threads: Optional[int] = None,
+                          depth: Optional[int] = None
                           ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """The COMPRESSED ingest pipeline: wire bytes → C++ int64 packer →
     numpy wirec compression (~10-18 B/event, ops/wirec.py) → H2D → device
@@ -152,70 +173,110 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
 
     The wirec profile is measured on the FIRST chunk and pinned so every
     chunk shares one executable; a later chunk whose values fall outside
-    the pinned widths triggers a refit (recompute + recompile) — counted
-    in the report, never silent."""
+    the pinned widths triggers a refit (recompute + recompile, and the
+    refreshed plan becomes the pin for chunks packed after it) — counted
+    in the report, never silent. Compression runs chunk-parallel inside
+    the pack pool (pack_wirec's num_threads path), so host packing scales
+    with cores instead of pinning one."""
     import jax
 
     from ..ops.replay import replay_wirec_to_crc
     from ..ops.wirec import ProfileMisfit, pack_wirec
-    from ..utils import metrics as m
-    from ..utils.profiler import ReplayProfiler
 
-    prof = ReplayProfiler()
     total = len(blobs)
-    report = FeedReport(workflows=total)
-    depth = 2
+    executor = BulkReplayExecutor(depth=depth)
+    report = FeedReport(workflows=total, depth=executor.depth)
+    prof = ReplayProfiler()
     buffers = [np.empty((chunk_workflows, max_events, packing.NUM_LANES),
-                        dtype=np.int64) for _ in range(depth)]
-    profile = None
-    start = time.perf_counter()
-    device_outs: List[Tuple] = []
-    for ci, lo in enumerate(range(0, total, chunk_workflows)):
-        if ci >= depth:
-            with prof.leg(m.M_PROFILE_KERNEL):
-                jax.block_until_ready(device_outs[ci - depth])
-        chunk = list(blobs[lo:lo + chunk_workflows])
-        pad = chunk_workflows - len(chunk)
-        if pad:
-            chunk.extend([_EMPTY_BLOB] * pad)
+                        dtype=np.int64) for _ in range(executor.depth)]
+    n_chunks = -(-total // chunk_workflows) if total else 0
+    # intra-chunk wirec threads: split the cores across the pack pool
+    wirec_threads = (num_threads if num_threads is not None
+                     else max(1, (os.cpu_count() or 2) // executor.depth))
+
+    # chunk 0 measures the profile; later pack tasks pin the latest plan
+    # (a refit replaces it under the lock)
+    first_profile: Future = Future()
+    state_lock = Lock()
+    shared = {"profile": None, "refits": 0,
+              "pack_s": 0.0, "compress_s": 0.0,
+              "events": 0, "wire_bytes": 0}
+
+    def pack(ci):
+        chunk = _chunk_blobs(blobs, ci * chunk_workflows, chunk_workflows)
         t0 = time.perf_counter()
         packed = packing.pack_serialized(chunk, max_events,
                                          num_threads=num_threads,
-                                         out=buffers[ci % depth])
+                                         out=buffers[ci % executor.depth])
         pack_dt = time.perf_counter() - t0
-        report.pack_s += pack_dt
         t0 = time.perf_counter()
         try:
-            corpus = pack_wirec(packed, profile=profile)
-        except ProfileMisfit:
-            corpus = pack_wirec(packed)  # refit: fresh plan, recompile
-            report.profile_refits += 1
-        profile = corpus.profile
+            if ci == 0:
+                corpus = pack_wirec(packed, num_threads=wirec_threads)
+                with state_lock:
+                    shared["profile"] = corpus.profile
+                first_profile.set_result(corpus.profile)
+            else:
+                first_profile.result()
+                with state_lock:
+                    pinned = shared["profile"]
+                try:
+                    corpus = pack_wirec(packed, profile=pinned,
+                                        num_threads=wirec_threads)
+                except ProfileMisfit:
+                    # refit: fresh plan, recompile; later chunks pin it
+                    corpus = pack_wirec(packed, num_threads=wirec_threads)
+                    with state_lock:
+                        shared["profile"] = corpus.profile
+                        shared["refits"] += 1
+        except BaseException as exc:
+            if ci == 0 and not first_profile.done():
+                first_profile.set_exception(exc)
+            raise
         compress_dt = time.perf_counter() - t0
-        report.compress_s += compress_dt
+        with state_lock:
+            shared["pack_s"] += pack_dt
+            shared["compress_s"] += compress_dt
+            shared["events"] += int(corpus.n_events.sum())
+            shared["wire_bytes"] += corpus.wire_bytes
         # compression is part of the host pack cost in this pipeline
-        prof.observe(m.M_PROFILE_PACK, pack_dt + compress_dt)
-        report.events += int(corpus.n_events.sum())
-        report.wire_bytes += corpus.wire_bytes
+        # (the executor already recorded the full pack task; fold the
+        # split into the report fields instead)
+        return corpus
+
+    def launch(ci, corpus):
         with prof.leg(m.M_PROFILE_H2D):
             parts = (jax.device_put(corpus.slab),
                      jax.device_put(corpus.bases),
                      jax.device_put(corpus.n_events))
             prof.h2d(corpus.wire_bytes)
-        device_outs.append(replay_wirec_to_crc(*parts, profile, layout))
-        report.chunks += 1
-    with prof.leg(m.M_PROFILE_READBACK):
-        first = np.concatenate(
-            [np.asarray(r) for r, _ in device_outs])[:total]
-        errors = np.concatenate(
-            [np.asarray(e) for _, e in device_outs])[:total]
+        return replay_wirec_to_crc(*parts, corpus.profile, layout)
+
+    def consume(ci, outs):
+        with prof.leg(m.M_PROFILE_KERNEL):
+            jax.block_until_ready(outs)
+        with prof.leg(m.M_PROFILE_READBACK):
+            return np.asarray(outs[0]), np.asarray(outs[1])
+
+    start = time.perf_counter()
+    results, prep = executor.run(n_chunks, pack, launch, consume)
+    first = np.concatenate([r for r, _ in results])[:total]
+    errors = np.concatenate([e for _, e in results])[:total]
+    report.chunks = prep.chunks
+    report.pack_queue_wait_s = prep.pack_queue_wait_s
+    report.pack_s = shared["pack_s"]
+    report.compress_s = shared["compress_s"]
+    report.events = shared["events"]
+    report.wire_bytes = shared["wire_bytes"]
+    report.profile_refits = shared["refits"]
     report.wall_s = time.perf_counter() - start
     return first, errors, report
 
 
 def feed_corpus(histories, chunk_workflows: int = 4096,
                 layout: PayloadLayout = DEFAULT_LAYOUT,
-                max_events: int = 0
+                max_events: int = 0,
+                depth: Optional[int] = None
                 ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed an in-memory corpus."""
     from ..core.codec import serialize_corpus
@@ -224,12 +285,13 @@ def feed_corpus(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized(serialize_corpus(histories), max_events,
-                           chunk_workflows, layout)
+                           chunk_workflows, layout, depth=depth)
 
 
 def feed_corpus32(histories, chunk_workflows: int = 4096,
                   layout: PayloadLayout = DEFAULT_LAYOUT,
-                  max_events: int = 0
+                  max_events: int = 0,
+                  depth: Optional[int] = None
                   ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed a corpus through the wire32 pipeline."""
     from ..core.codec import serialize_corpus
@@ -238,12 +300,13 @@ def feed_corpus32(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized32(serialize_corpus(histories), max_events,
-                             chunk_workflows, layout)
+                             chunk_workflows, layout, depth=depth)
 
 
 def feed_corpus_wirec(histories, chunk_workflows: int = 4096,
                       layout: PayloadLayout = DEFAULT_LAYOUT,
-                      max_events: int = 0
+                      max_events: int = 0,
+                      depth: Optional[int] = None
                       ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed a corpus through the compressed
     wirec pipeline."""
@@ -253,4 +316,4 @@ def feed_corpus_wirec(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized_wirec(serialize_corpus(histories), max_events,
-                                 chunk_workflows, layout)
+                                 chunk_workflows, layout, depth=depth)

@@ -12,7 +12,7 @@ native/ implements the same schema for production feed rates.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -175,6 +175,50 @@ def encode_history(batches: Sequence[HistoryBatch], max_events: int) -> np.ndarr
             interner = _Interner()
             row = _emit_events(out, row, batch.new_run_events, interner,
                                reset_first=True)
+    return out
+
+
+def encode_batches_resumable(batches: Sequence[HistoryBatch],
+                             interner_map: "Dict[str, int]" = None
+                             ) -> "Tuple[np.ndarray, Dict[str, int]]":
+    """Pack batches into UNPADDED [n, L] rows, resuming from a prior
+    interner state: feeding appended batches back in (with the returned
+    map) extends the lanes byte-identically to encode_history having seen
+    the whole history at once. This is the pack cache's suffix-pack
+    primitive (engine/cache.py PackCache): histories are append-only, so
+    a re-verify after one appended batch only pays for the suffix.
+
+    Returns (rows, interner_map) — the map is a snapshot (the caller may
+    cache it; later calls never mutate an earlier snapshot)."""
+    total = history_length(batches)
+    out = np.zeros((total, NUM_LANES), dtype=np.int64)
+    out[:, LANE_EVENT_TYPE] = -1
+    interner = _Interner()
+    if interner_map:
+        interner._map = dict(interner_map)
+    row = 0
+    for batch in batches:
+        row = _emit_events(out, row, batch.events, interner)
+        if batch.new_run_events:
+            # fresh interner: the new run's string IDs are a new namespace
+            interner = _Interner()
+            row = _emit_events(out, row, batch.new_run_events, interner,
+                               reset_first=True)
+    return out[:row], dict(interner._map)
+
+
+def assemble_corpus(rows_list: Sequence[np.ndarray],
+                    max_events: int = 0) -> np.ndarray:
+    """Stack per-workflow UNPADDED [n, L] row blocks into a padded
+    [W, E, L] corpus, byte-identical to encode_corpus on the same
+    histories (pad rows are zero with event_type -1)."""
+    if max_events <= 0:
+        max_events = max((r.shape[0] for r in rows_list), default=0)
+    W = len(rows_list)
+    out = np.zeros((W, max_events, NUM_LANES), dtype=np.int64)
+    out[:, :, LANE_EVENT_TYPE] = -1
+    for i, rows in enumerate(rows_list):
+        out[i, :rows.shape[0]] = rows
     return out
 
 

@@ -160,6 +160,20 @@ def replay_wirec_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
     return crc32_rows(payload_rows(s, layout)), s.error
 
 
+@jax.jit
+def verify_rows(rows: jnp.ndarray, expected_rows: jnp.ndarray,
+                branch: jnp.ndarray, expected_branch: jnp.ndarray
+                ) -> jnp.ndarray:
+    """Device-side verify_all compare: payload rows and the device-chosen
+    current branch against the expected (live mutable-state) values, ON
+    DEVICE — the host reads back one mismatch bit per workflow instead of
+    the full [W, width] payload tensor. A set bit means row divergence OR
+    branch-arbitration disagreement (verify_all treats both as
+    divergent, so the OR loses nothing)."""
+    row_mismatch = (rows != expected_rows).any(axis=1)
+    return row_mismatch | (branch != expected_branch.astype(branch.dtype))
+
+
 def replay_corpus(histories: Sequence[Sequence[HistoryBatch]],
                   layout: PayloadLayout = DEFAULT_LAYOUT,
                   max_events: int = 0,

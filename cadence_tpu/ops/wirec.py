@@ -43,6 +43,7 @@ profile — measured, never silent).
 """
 from __future__ import annotations
 
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -184,42 +185,11 @@ def _check_fit(code: np.ndarray, width: int) -> bool:
     return bool((code >= lo).all() and (code <= hi).all())
 
 
-def pack_wirec(events64: np.ndarray,
-               profile: Optional[Tuple[LaneCode, ...]] = None) -> WirecCorpus:
-    """[W, E, NUM_LANES] int64 → WirecCorpus.
-
-    With `profile` pinned (streaming chunks sharing one executable), the
-    chunk is packed under that plan; values that don't fit its
-    widths/scales raise ProfileMisfit so the caller refits explicitly.
-    """
-    ev = np.asarray(events64, dtype=np.int64)
-    W, E, L = ev.shape
-    assert L == NUM_LANES, f"expected {NUM_LANES} lanes, got {L}"
-    n = (ev[:, :, LANE_EVENT_ID] > 0).sum(axis=1).astype(np.int32)
-    mask = np.arange(E)[None, :] < n[:, None]
-    # row 0 is real whenever n > 0, so the first-row value IS the base
-    ts_base = ev[:, 0, LANE_TIMESTAMP]
-
-    if profile is None:
-        plans = [_plan_lane(ev[:, :, lane], mask, n, ts_base)
-                 for lane in range(NUM_LANES)]
-        off = 0
-        base_cols = 0
-        entries = []
-        for lane, (kind, width, scale, const) in enumerate(plans):
-            bi = -1
-            if kind in (KIND_DELTA, KIND_TSREL_NZ):
-                bi = base_cols
-                base_cols += 1
-            entries.append(LaneCode(lane, kind, off if width else 0, width,
-                                    scale, const, bi))
-            off += width
-        profile = tuple(entries)
-
-    B = sum(e.width for e in profile)
-    K = sum(1 for e in profile if e.base_index >= 0)
-    slab = np.zeros((W, E, B), dtype=np.uint8)
-    bases = np.zeros((W, K), dtype=np.int64)
+def _pack_rows(ev: np.ndarray, mask: np.ndarray, n: np.ndarray,
+               ts_base: np.ndarray, profile: Tuple[LaneCode, ...],
+               slab: np.ndarray, bases: np.ndarray) -> None:
+    """Emit every lane of a [w, E, L] row block into its slab/bases slice
+    (each transform is per-workflow-row, so blocks are independent)."""
     for e in profile:
         v = ev[:, :, e.lane]
         if e.kind == KIND_CONST:
@@ -245,6 +215,94 @@ def pack_wirec(events64: np.ndarray,
         _emit(slab, e.offset, e.width, code)
         if base is not None:
             bases[:, e.base_index] = base
+
+
+#: minimum rows per thread block: below this the pool overhead beats the
+#: numpy-releases-the-GIL parallelism win
+_MIN_BLOCK_ROWS = 256
+
+#: process-lifetime pack pools by worker count — the wirec feeder calls
+#: pack_wirec once per chunk, so per-call pool spawn/join would be pure
+#: overhead on the exact path this parallelism is optimizing
+_POOLS: dict = {}
+_POOLS_LOCK = threading.Lock()
+
+
+def _pack_pool(threads: int):
+    with _POOLS_LOCK:
+        pool = _POOLS.get(threads)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = _POOLS[threads] = ThreadPoolExecutor(
+                max_workers=threads, thread_name_prefix="wirec-pack")
+        return pool
+
+
+def pack_wirec(events64: np.ndarray,
+               profile: Optional[Tuple[LaneCode, ...]] = None,
+               num_threads: Optional[int] = None) -> WirecCorpus:
+    """[W, E, NUM_LANES] int64 → WirecCorpus.
+
+    With `profile` pinned (streaming chunks sharing one executable), the
+    chunk is packed under that plan; values that don't fit its
+    widths/scales raise ProfileMisfit so the caller refits explicitly.
+
+    `num_threads` > 1 enables the chunk-parallel path: lane PLANNING fans
+    out per lane and EMIT fans out over workflow-row blocks (every
+    transform — delta, GCD scaling, ts-rel — is per-workflow, so blocks
+    are independent and the packed bytes are identical to the serial
+    path). numpy releases the GIL inside the ufunc loops, so host packing
+    scales with cores instead of pinning one.
+    """
+    ev = np.asarray(events64, dtype=np.int64)
+    W, E, L = ev.shape
+    assert L == NUM_LANES, f"expected {NUM_LANES} lanes, got {L}"
+    n = (ev[:, :, LANE_EVENT_ID] > 0).sum(axis=1).astype(np.int32)
+    mask = np.arange(E)[None, :] < n[:, None]
+    # row 0 is real whenever n > 0, so the first-row value IS the base
+    ts_base = ev[:, 0, LANE_TIMESTAMP]
+
+    threads = 1 if num_threads is None else max(1, int(num_threads))
+    if W < 2 * _MIN_BLOCK_ROWS:
+        threads = 1
+    pool = _pack_pool(threads) if threads > 1 else None
+
+    if profile is None:
+        if pool is not None:
+            plans = list(pool.map(
+                lambda lane: _plan_lane(ev[:, :, lane], mask, n, ts_base),
+                range(NUM_LANES)))
+        else:
+            plans = [_plan_lane(ev[:, :, lane], mask, n, ts_base)
+                     for lane in range(NUM_LANES)]
+        off = 0
+        base_cols = 0
+        entries = []
+        for lane, (kind, width, scale, const) in enumerate(plans):
+            bi = -1
+            if kind in (KIND_DELTA, KIND_TSREL_NZ):
+                bi = base_cols
+                base_cols += 1
+            entries.append(LaneCode(lane, kind, off if width else 0,
+                                    width, scale, const, bi))
+            off += width
+        profile = tuple(entries)
+
+    B = sum(e.width for e in profile)
+    K = sum(1 for e in profile if e.base_index >= 0)
+    slab = np.zeros((W, E, B), dtype=np.uint8)
+    bases = np.zeros((W, K), dtype=np.int64)
+    if pool is not None:
+        block = max(_MIN_BLOCK_ROWS, -(-W // threads))
+        bounds = [(lo, min(lo + block, W)) for lo in range(0, W, block)]
+        list(pool.map(
+            lambda b: _pack_rows(ev[b[0]:b[1]], mask[b[0]:b[1]],
+                                 n[b[0]:b[1]], ts_base[b[0]:b[1]],
+                                 profile, slab[b[0]:b[1]],
+                                 bases[b[0]:b[1]]),
+            bounds))
+    else:
+        _pack_rows(ev, mask, n, ts_base, profile, slab, bases)
     return WirecCorpus(slab, bases, n, profile)
 
 

@@ -39,6 +39,7 @@ SCOPE_QUEUE_TIMER = "queue.timer"
 SCOPE_REPLICATION = "replication.task-processor"
 SCOPE_TPU_REPLAY = "tpu.replay-engine"
 SCOPE_REBUILD = "tpu.device-rebuilder"
+SCOPE_PACK_CACHE = "tpu.pack-cache"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -75,7 +76,16 @@ M_PROFILE_PACK = "pack"
 M_PROFILE_H2D = "h2d"
 M_PROFILE_KERNEL = "kernel"
 M_PROFILE_READBACK = "readback"
+#: time the device consumer spends waiting on the pack producer pipeline
+#: (engine/executor.py): non-zero p50 here means the host packers are
+#: starving the device; a near-zero leg means the device is the bottleneck
+M_PROFILE_PACK_WAIT = "pack-queue-wait"
 M_H2D_BYTES = "h2d-bytes"
+#: pack-cache counters (engine/cache.py PackCache, SCOPE_PACK_CACHE)
+M_CACHE_HITS = "hits"
+M_CACHE_MISSES = "misses"
+M_CACHE_EVICTIONS = "evictions"
+M_CACHE_SUFFIX_PACKS = "suffix-packs"
 
 
 #: latency buckets (seconds): sub-ms sync paths through multi-second

@@ -5,10 +5,14 @@ without decomposing a launch into its host legs. Every instrumented
 replay path (engine/tpu_engine.py, engine/rebuild.py, native/feeder.py,
 ops/replay.replay_corpus) wraps its phases in a ReplayProfiler:
 
-  pack     — host encode/pack of the event corpus
-  h2d      — host→device transfer dispatch (+ bytes moved, M_H2D_BYTES)
-  kernel   — device replay compute, measured to block_until_ready
-  readback — device→host pull of payload rows / CRCs / errors
+  pack            — host encode/pack of the event corpus
+  pack-queue-wait — device consumer stalled waiting on the pack producer
+                    pipeline (engine/executor.py): this leg growing means
+                    host packing is starving the device; near-zero means
+                    the device side is the bottleneck
+  h2d             — host→device transfer dispatch (+ bytes, M_H2D_BYTES)
+  kernel          — device replay compute, measured to block_until_ready
+  readback        — device→host pull of payload rows / CRCs / errors
 
 Legs land as histograms under the component's scope (SCOPE_TPU_REPLAY by
 default, SCOPE_REBUILD for the rebuilder), so `/metrics` scrapes, the
@@ -23,8 +27,8 @@ from typing import Dict, Optional
 from . import metrics as m
 
 #: the leg metric names, in pipeline order
-LEGS = (m.M_PROFILE_PACK, m.M_PROFILE_H2D, m.M_PROFILE_KERNEL,
-        m.M_PROFILE_READBACK)
+LEGS = (m.M_PROFILE_PACK, m.M_PROFILE_PACK_WAIT, m.M_PROFILE_H2D,
+        m.M_PROFILE_KERNEL, m.M_PROFILE_READBACK)
 
 
 class ReplayProfiler:

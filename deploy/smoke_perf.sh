@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Perf smoke: run the SMALL bench suite through the pipelined bulk
+# executor, write the JSON next to the recorded BENCH_r*.json trajectory
+# (PERF_smoke.json), and FAIL unless crc_parity_wire32 (and the
+# pipelined-path parity) hold and every suite's transfer_included_rate
+# stays within PERF_TOLERANCE (default 0.5x) of the recorded baseline —
+# by default the newest BENCH_r*.json, overridable with the first arg.
+# The assertions live in tests/test_perf_gate.py, marked `perf`.
+#
+# Usage: deploy/smoke_perf.sh [baseline.json] [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BASELINE="${1:-}"
+if [ $# -ge 1 ]; then shift; fi
+if [ -z "$BASELINE" ]; then
+    BASELINE=$(ls -1 BENCH_r*.json 2>/dev/null | sort | tail -1 || true)
+fi
+[ -n "$BASELINE" ] || { echo "no baseline BENCH_r*.json found"; exit 1; }
+
+OUT="PERF_smoke.json"
+echo "perf smoke: baseline=$BASELINE -> $OUT"
+env BENCH_NS_WORKFLOWS="${BENCH_NS_WORKFLOWS:-16384}" \
+    BENCH_NS_EVENTS="${BENCH_NS_EVENTS:-128}" \
+    BENCH_NS_CHUNK="${BENCH_NS_CHUNK:-4096}" \
+    BENCH_SUITE_WORKFLOWS="${BENCH_SUITE_WORKFLOWS:-16384}" \
+    BENCH_TRIALS="${BENCH_TRIALS:-3}" \
+    python bench.py > "$OUT"
+
+exec env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \
+    JAX_PLATFORMS=cpu python -m pytest tests/test_perf_gate.py \
+    -m perf -q "$@"

@@ -1,0 +1,109 @@
+"""Perf gate (deploy/smoke_perf.sh, marker `perf`).
+
+Two layers:
+
+1. Always-on zero-divergence checks: the pipelined bulk executor's
+   chunked, overlapped transfer path must produce exactly the CRCs of a
+   one-shot replay, and the chunk-parallel wirec packer must emit
+   byte-identical wire bytes — a perf path that changes results is not a
+   perf path.
+
+2. Baseline regression gate: when PERF_CURRENT / PERF_BASELINE point at
+   bench JSON files (the smoke script runs the small bench and wires the
+   output next to the BENCH_r*.json trajectory), every common suite's
+   `transfer_included_rate` must stay within PERF_TOLERANCE (default
+   0.5x) of the recorded baseline, and `crc_parity_wire32` must hold.
+   Without the env vars the gate skips — rate asserts on shared CI boxes
+   are noise, the smoke script is the place that pins hardware.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.ops.encode import encode_corpus
+
+pytestmark = pytest.mark.perf
+
+
+class TestPipelinedParity:
+    def test_chunk_parallel_pack_wirec_byte_identical(self):
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        hists = generate_corpus("timer_retry", num_workflows=640, seed=23,
+                                target_events=24)
+        ev = encode_corpus(hists)
+        serial = pack_wirec(ev)
+        threaded = pack_wirec(ev, num_threads=4)
+        assert serial.profile == threaded.profile
+        assert (serial.slab == threaded.slab).all()
+        assert (serial.bases == threaded.bases).all()
+        assert (serial.n_events == threaded.n_events).all()
+
+    def test_pipelined_transfer_crc_equals_oneshot(self):
+        """bench's transfer-included measurement path: chunked executor
+        streaming == single sharded launch, CRC for CRC."""
+        import jax
+
+        import bench
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+        from cadence_tpu.ops.wirec import pack_wirec
+        from cadence_tpu.parallel.mesh import (
+            make_mesh,
+            replay_wirec_sharded_crc,
+        )
+
+        hists = generate_corpus("basic", num_workflows=64, seed=29,
+                                target_events=24)
+        corpus = pack_wirec(encode_corpus(hists))
+        mesh = make_mesh()
+        n_devices = jax.device_count()
+        n_chunks = next(nc for nc in (4, 2, 1)
+                        if 64 % nc == 0 and (64 // nc) % n_devices == 0)
+        run = bench._pipelined_transfer(corpus, mesh, DEFAULT_LAYOUT,
+                                        n_chunks, depth=3)
+        crcs_p, errs_p = run()
+        crc_1, err_1, _ = replay_wirec_sharded_crc(corpus, mesh,
+                                                   DEFAULT_LAYOUT)
+        assert (crcs_p == np.asarray(crc_1).astype(np.uint32)).all()
+        assert (errs_p == np.asarray(err_1)).all()
+        assert (int(np.bitwise_xor.reduce(crcs_p))
+                == int(np.bitwise_xor.reduce(
+                    np.asarray(crc_1).astype(np.uint32))))
+
+
+class TestBaselineGate:
+    def _load(self, env):
+        path = os.environ.get(env, "")
+        if not path or not os.path.exists(path):
+            pytest.skip(f"{env} not set (run via deploy/smoke_perf.sh)")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_transfer_rate_within_tolerance_of_baseline(self):
+        current = self._load("PERF_CURRENT")
+        baseline = self._load("PERF_BASELINE")
+        tol = float(os.environ.get("PERF_TOLERANCE", "0.5"))
+        cur_suites = current["detail"]["suites"]
+        base_suites = baseline["detail"]["suites"]
+        checked = 0
+        for suite, cur in cur_suites.items():
+            assert cur["crc_parity_wire32"], f"{suite}: wire32 CRC parity broken"
+            assert cur.get("crc_parity_pipelined", True), \
+                f"{suite}: pipelined CRC parity broken"
+            base = base_suites.get(suite)
+            if base is None:
+                continue
+            if cur["workflows"] == base["workflows"]:
+                # same corpus config ⇒ the checksum must not have moved
+                assert cur["crc_xor"] == base["crc_xor"], \
+                    f"{suite}: crc_xor drifted from baseline"
+            floor = tol * base["transfer_included_rate"]
+            assert cur["transfer_included_rate"] >= floor, (
+                f"{suite}: transfer_included_rate "
+                f"{cur['transfer_included_rate']} regressed below "
+                f"{tol:.0%} of baseline {base['transfer_included_rate']}")
+            checked += 1
+        assert checked, "no common suites between current and baseline"
