@@ -324,6 +324,7 @@ def main(argv=None) -> int:
             result = admin.verify()
             _emit({"total": result.total,
                    "verified_on_device": result.verified_on_device,
+                   "escalated": len(result.escalated),
                    "fallback": len(result.fallback),
                    "divergent": result.divergent, "ok": result.ok})
             return 0 if result.ok else 1

@@ -62,6 +62,8 @@ class PipelineReport:
     depth: int = 0
     pack_s: float = 0.0             # summed host pack seconds (inside pack_fn)
     pack_queue_wait_s: float = 0.0  # consumer stalled on the pack pipeline
+    escalate_s: float = 0.0         # summed escalate_fn seconds (host side
+                                    # of capacity-escalation dispatch)
     wall_s: float = 0.0
 
 
@@ -80,6 +82,17 @@ class BulkReplayExecutor:
                                 1 behind the newest launch — block/read
                                 back here so only O(depth) chunk outputs
                                 are ever live.
+      escalate_fn(ci, out)      optional (requires consume_fn); called
+                                right after consume_fn(ci) with its
+                                result, in the same launch order. The
+                                capacity-escalation seam: inspect the
+                                read-back error lanes and DISPATCH any
+                                widened-K re-replay asynchronously here
+                                (engine/ladder.py submit) — the pack pool
+                                keeps producing up to `depth` chunks
+                                ahead the whole time, so escalation never
+                                stalls the pack pipeline. Its return
+                                value replaces the chunk's output.
     """
 
     def __init__(self, depth: Optional[int] = None,
@@ -91,10 +104,12 @@ class BulkReplayExecutor:
     def run(self, num_chunks: int,
             pack_fn: Callable[[int], Any],
             launch_fn: Callable[[int, Any], Any],
-            consume_fn: Optional[Callable[[int, Any], Any]] = None
+            consume_fn: Optional[Callable[[int, Any], Any]] = None,
+            escalate_fn: Optional[Callable[[int, Any], Any]] = None
             ) -> tuple:
-        """Returns (outputs, PipelineReport); outputs[ci] is consume_fn's
-        return value when given, else launch_fn's device output."""
+        """Returns (outputs, PipelineReport); outputs[ci] is the last
+        hook's return value (escalate_fn over consume_fn over
+        launch_fn's device output)."""
         import jax
 
         prof = ReplayProfiler(self.registry, scope=self.scope)
@@ -146,9 +161,13 @@ class BulkReplayExecutor:
                     if consume_fn is not None and ci >= 1:
                         # lag-1 readback: chunk ci is in flight while
                         # chunk ci-1 is pulled, and outputs never pile up
-                        outs[ci - 1] = consume_fn(ci - 1, outs[ci - 1])
+                        outs[ci - 1] = self._consume(ci - 1, outs[ci - 1],
+                                                     consume_fn,
+                                                     escalate_fn, report)
                 if consume_fn is not None and num_chunks:
-                    outs[-1] = consume_fn(num_chunks - 1, outs[-1])
+                    outs[-1] = self._consume(num_chunks - 1, outs[-1],
+                                             consume_fn, escalate_fn,
+                                             report)
             finally:
                 # a pack/launch failure must not wedge pool shutdown:
                 # unblock every pack task still waiting on a launch that
@@ -160,3 +179,15 @@ class BulkReplayExecutor:
                         fut.set_result(None)
         report.wall_s = time.perf_counter() - t_start
         return outs, report
+
+    @staticmethod
+    def _consume(ci: int, out: Any,
+                 consume_fn: Callable[[int, Any], Any],
+                 escalate_fn: Optional[Callable[[int, Any], Any]],
+                 report: PipelineReport) -> Any:
+        out = consume_fn(ci, out)
+        if escalate_fn is not None:
+            t0 = time.perf_counter()
+            out = escalate_fn(ci, out)
+            report.escalate_s += time.perf_counter() - t0
+        return out

@@ -1,0 +1,356 @@
+"""Capacity-escalation ladder: overflow pressure stays on device.
+
+The reference never degrades on capacity — its pending maps are unbounded
+Go maps (mutable_state_builder.go) — but the kernel's tables are fixed at
+PayloadLayout's K, so a workflow that transiently holds more than K
+pending items flags TABLE_OVERFLOW and, before this module, exited the
+batched kernel into a per-workflow Python oracle (BENCH_r05: 2.7% flagged
+workflows collapsed the mixed rate 3x, `oracle_leg_s_median` = 1.078s).
+
+The ladder replaces that scalar leg with batched device work: rows
+flagged with a CAPACITY error (ops/state.CAPACITY_ERRORS) are gathered
+into a compact sub-corpus (ops/encode.gather_subcorpus /
+ops/wirec.gather_corpus) and re-replayed ON DEVICE with every capacity
+doubled — K→2K→4K up a bounded rung ladder — then projected back to the
+BASE payload width (ops/payload.payload_rows_narrow), so resolved rows
+hash byte-identically to what the oracle would have produced. Only rows
+that still overflow at the top rung (or whose FINAL state exceeds the
+canonical payload itself, or whose error no capacity can fix) remain for
+oracle arbitration — measured, counted, never silent.
+
+Costs are amortized and observable:
+- each (rung, wire format, padded shape) kernel variant is one extra
+  compile, registered in utils/compile_cache.KernelVariantCache — warm
+  runs pay zero recompiles and the hit/miss counters prove it;
+- sub-corpus shapes are pow2-bucketed (workflow AND event axes), so
+  run-to-run wobble in the flagged count reuses the same executable;
+- counters land under `tpu.fallback/*` (rows per rung, rung compiles,
+  resolved/residual rows) and rung time lands as the profiler's
+  `fallback` leg.
+
+submit()/finish() split the work so the pipelined executor
+(engine/executor.py) can dispatch rung-1 re-replays asynchronously per
+chunk while later chunks still pack and replay; rungs ≥ 2 run once,
+batched across every chunk's survivors.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
+from ..ops.encode import gather_subcorpus
+from ..ops.state import CAPACITY_ERRORS, widen_layout
+from ..utils import compile_cache
+from ..utils import metrics as m
+
+#: rungs above base capacity (K→2K→4K with the default 2); bounded — each
+#: rung is one more compiled variant and 2x the per-row state footprint
+RUNGS_ENV = "CADENCE_TPU_LADDER_RUNGS"
+DEFAULT_RUNGS = 2
+
+_CAPACITY = np.asarray(CAPACITY_ERRORS, dtype=np.int32)
+
+
+def _pow2(n: int, floor: int) -> int:
+    return max(floor, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+@dataclass
+class PendingEscalation:
+    """One chunk's dispatched rung-1 re-replay (submit() → finish())."""
+
+    sub: np.ndarray          # trimmed [F, E, L] sub-corpus (host copy)
+    outs: tuple              # rung-1 device arrays (rows, err, ovf, branch)
+    count: int               # real rows (padding excluded)
+
+
+@dataclass
+class LadderOutcome:
+    """Final arbitration-ready results for F flagged rows."""
+
+    rows: np.ndarray         # [F, base_width] (valid where resolved)
+    resolved: np.ndarray     # [F] bool — device-resolved at some rung
+    errors: np.ndarray       # [F] i32 — last rung's error per row
+    branch: np.ndarray       # [F] i32 — device-chosen current branch
+    rungs: List[dict] = field(default_factory=list)  # per-rung accounting
+
+
+class EscalationLadder:
+    """Widened-K re-replay ladder over capacity-flagged rows."""
+
+    def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT,
+                 max_rungs: Optional[int] = None,
+                 registry=None, mesh=None,
+                 variants: Optional[compile_cache.KernelVariantCache] = None
+                 ) -> None:
+        self.layout = layout
+        self.max_rungs = (max_rungs if max_rungs is not None
+                          else int(os.environ.get(RUNGS_ENV,
+                                                  str(DEFAULT_RUNGS))))
+        self.max_rungs = max(1, self.max_rungs)
+        self.metrics = registry if registry is not None else m.DEFAULT_REGISTRY
+        #: when set, rungs re-replay SPMD under the mesh's 'shard' axis
+        #: (parallel/mesh.py escalated paths) instead of single-device
+        self.mesh = mesh
+        self.variants = (variants if variants is not None
+                         else compile_cache.DEFAULT_VARIANTS)
+        #: per-rung accounting of the most recent escalate/finish call
+        #: (bench.py reports per-rung rates from this)
+        self.last_run: List[dict] = []
+
+    # -- shared mechanics ---------------------------------------------------
+
+    def rung_layout(self, rung: int) -> PayloadLayout:
+        return widen_layout(self.layout, 2 ** rung)
+
+    def _shards(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None else 0
+
+    def _pad_dims(self, F: int, E: int) -> Tuple[int, int]:
+        """Pow2-bucketed padded shape; the workflow axis also rounds up to
+        a multiple of the mesh so every shard gets a whole slice."""
+        Wp = _pow2(F, 8)
+        n = self._shards()
+        if n > 1 and Wp % n:
+            Wp = -(-Wp // n) * n
+        return Wp, _pow2(E, 16)
+
+    @staticmethod
+    def capacity_flagged(errors: np.ndarray) -> np.ndarray:
+        """Local indices of rows whose error a wider K could clear."""
+        return np.nonzero(np.isin(np.asarray(errors), _CAPACITY))[0]
+
+    def _record_rung(self, rung: int, rows: int, seconds: float) -> None:
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.ladder_rung_rows(rung), rows)
+        self.metrics.observe(m.SCOPE_TPU_FALLBACK, m.M_PROFILE_FALLBACK,
+                             seconds)
+        self.last_run.append({"rung": rung, "rows": rows,
+                              "seconds": round(seconds, 6)})
+
+    def _finalize(self, resolved: np.ndarray) -> None:
+        n_res = int(resolved.sum())
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_RESOLVED, n_res)
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_RESIDUAL,
+                         len(resolved) - n_res)
+
+    def _dense_fn(self, rung: int, Wp: int, Ep: int, keep_state: bool):
+        """The compiled dense-lane rung variant, via the variant cache
+        (a miss is exactly one XLA compile; warm runs always hit)."""
+        import jax.numpy as jnp
+
+        layout_r = self.rung_layout(rung)
+        key = ("dense", self.layout, rung, Wp, Ep, self._shards(), keep_state)
+
+        def build():
+            if self.mesh is not None and not keep_state:
+                from ..parallel.mesh import replay_sharded_escalated
+                return lambda ev: replay_sharded_escalated(
+                    jnp.asarray(ev), self.mesh, layout_r, self.layout)
+            if keep_state:
+                from ..ops.replay import replay_escalated_state
+                return lambda ev: replay_escalated_state(
+                    jnp.asarray(ev), layout_r, self.layout)
+            from ..ops.replay import replay_escalated
+            return lambda ev: replay_escalated(jnp.asarray(ev), layout_r,
+                                               self.layout)
+
+        return self.variants.get(key, build, self.metrics)
+
+    def _pad_dense(self, sub: np.ndarray) -> np.ndarray:
+        F, E = sub.shape[:2]
+        Wp, Ep = self._pad_dims(F, E)
+        return gather_subcorpus(sub, np.arange(F), Wp, Ep)
+
+    # -- dense-lane path (verify/replay engines) ----------------------------
+
+    def submit(self, sub: np.ndarray) -> PendingEscalation:
+        """Dispatch the rung-1 re-replay of a trimmed [F, E, L] flagged
+        sub-corpus ASYNCHRONOUSLY (JAX async dispatch returns device
+        handles immediately): the pipelined executor calls this per chunk
+        so rung-1 compute overlaps later chunks' pack/replay."""
+        F = sub.shape[0]
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_FLAGGED, F)
+        padded = self._pad_dense(sub)
+        fn = self._dense_fn(1, padded.shape[0], padded.shape[1],
+                            keep_state=False)
+        return PendingEscalation(sub=sub, outs=fn(padded), count=F)
+
+    def finish(self, pending: Sequence[PendingEscalation]
+               ) -> List[LadderOutcome]:
+        """Collect rung-1 results and run rungs ≥ 2 ONCE, batched across
+        every pending chunk's survivors. Returns one outcome per pending,
+        aligned with its submitted rows."""
+        import jax
+
+        outcomes: List[LadderOutcome] = []
+        self.last_run = []
+        rung1_rows = sum(p.count for p in pending)
+        # (chunk index in `pending`, local row index) of rung-1 survivors
+        still: List[Tuple[int, int]] = []
+        t0 = time.perf_counter()
+        for pi, p in enumerate(pending):
+            jax.block_until_ready(p.outs)
+            # np.array (not asarray): rungs ≥ 2 patch these in place, and
+            # device readbacks come back as read-only views
+            rows, err, ovf, branch = (np.array(a) for a in p.outs)
+            F = p.count
+            rows, err, ovf, branch = rows[:F], err[:F], ovf[:F], branch[:F]
+            resolved = (err == 0) & ~ovf
+            outcomes.append(LadderOutcome(rows=rows, resolved=resolved,
+                                          errors=err, branch=branch))
+            still.extend((pi, int(j)) for j in self.capacity_flagged(err))
+        if rung1_rows:
+            self._record_rung(1, rung1_rows, time.perf_counter() - t0)
+
+        for rung in range(2, self.max_rungs + 1):
+            if not still:
+                break
+            t0 = time.perf_counter()
+            subs = []
+            flat = []
+            for pi in sorted({q for q, _ in still}):
+                idx = [j for q, j in still if q == pi]
+                subs.append(gather_subcorpus(pending[pi].sub, idx))
+                flat.extend((pi, j) for j in idx)
+            E = max(s.shape[1] for s in subs)
+            cur = np.concatenate([
+                gather_subcorpus(s, np.arange(s.shape[0]), 0, E)
+                for s in subs])
+            padded = self._pad_dense(cur)
+            fn = self._dense_fn(rung, padded.shape[0], padded.shape[1],
+                                keep_state=False)
+            rows, err, ovf, branch = (np.asarray(a)
+                                      for a in fn(padded))
+            next_still = []
+            for k, (pi, j) in enumerate(flat):
+                outcomes[pi].errors[j] = err[k]
+                outcomes[pi].branch[j] = branch[k]
+                if err[k] == 0 and not ovf[k]:
+                    outcomes[pi].rows[j] = rows[k]
+                    outcomes[pi].resolved[j] = True
+                elif err[k] in _CAPACITY:
+                    next_still.append((pi, j))
+            self._record_rung(rung, len(flat), time.perf_counter() - t0)
+            still = next_still
+
+        for o in outcomes:
+            o.rungs = list(self.last_run)
+            self._finalize(o.resolved)
+        return outcomes
+
+    def escalate(self, sub: np.ndarray) -> LadderOutcome:
+        """Synchronous full ladder over one trimmed sub-corpus."""
+        return self.finish([self.submit(sub)])[0]
+
+    # -- full-state path (engine/rebuild.py hydration) ----------------------
+
+    def escalate_states(self, sub: np.ndarray):
+        """Ladder that keeps the WIDENED rung states for hydration.
+        Returns (outcome, states) where states[k] is (state_arrays,
+        row_in_arrays) of the rung that resolved row k, or None."""
+        import jax
+
+        F = sub.shape[0]
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_FLAGGED, F)
+        self.last_run = []
+        rows_out = np.zeros((F, self.layout.width), np.int64)
+        resolved = np.zeros(F, bool)
+        err_out = np.zeros(F, np.int32)
+        branch_out = np.zeros(F, np.int32)
+        states: List[Optional[tuple]] = [None] * F
+        active = np.arange(F)
+        cur = sub
+        for rung in range(1, self.max_rungs + 1):
+            t0 = time.perf_counter()
+            padded = self._pad_dense(cur)
+            fn = self._dense_fn(rung, padded.shape[0], padded.shape[1],
+                                keep_state=True)
+            s_dev, rows_dev, err_dev, ovf_dev = fn(padded)
+            arrs = jax.device_get(s_dev)
+            rows = np.asarray(rows_dev)[:len(active)]
+            err = np.asarray(err_dev)[:len(active)]
+            ovf = np.asarray(ovf_dev)[:len(active)]
+            self._record_rung(rung, len(active), time.perf_counter() - t0)
+            ok = (err == 0) & ~ovf
+            for k in np.nonzero(ok)[0]:
+                gi = active[k]
+                rows_out[gi] = rows[k]
+                resolved[gi] = True
+                states[gi] = (arrs, int(k))
+                branch_out[gi] = int(arrs.current_branch[k])
+            err_out[active] = err
+            still = self.capacity_flagged(err)
+            if not len(still):
+                break
+            cur = gather_subcorpus(cur, still)
+            active = active[still]
+        self._finalize(resolved)
+        return (LadderOutcome(rows=rows_out, resolved=resolved,
+                              errors=err_out, branch=branch_out,
+                              rungs=list(self.last_run)), states)
+
+    # -- wirec path (bench / CRC consumers) ---------------------------------
+
+    def escalate_wirec(self, corpus, indices) -> Tuple[np.ndarray,
+                                                       np.ndarray,
+                                                       np.ndarray]:
+        """Full ladder over flagged rows of a wirec corpus, reduced on
+        device to base-width CRC32s. Returns (crc32 [F] uint32, resolved
+        [F] bool, errors [F] i32) aligned with `indices`."""
+        from ..ops.wirec import gather_corpus
+
+        idx = np.asarray(indices, dtype=np.int64)
+        F = len(idx)
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_FLAGGED, F)
+        self.last_run = []
+        crcs_out = np.zeros(F, np.uint32)
+        resolved = np.zeros(F, bool)
+        err_out = np.zeros(F, np.int32)
+        active = np.arange(F)
+        cur = gather_corpus(corpus, idx)
+        for rung in range(1, self.max_rungs + 1):
+            t0 = time.perf_counter()
+            Wp, Ep = self._pad_dims(len(active), cur.slab.shape[1])
+            padded = gather_corpus(cur, np.arange(len(active)), Wp, Ep)
+            fn = self._wirec_fn(rung, Wp, Ep, padded.profile)
+            crc_dev, err_dev, ovf_dev = fn(padded)
+            crc = np.asarray(crc_dev)[:len(active)].astype(np.uint32)
+            err = np.asarray(err_dev)[:len(active)]
+            ovf = np.asarray(ovf_dev)[:len(active)]
+            self._record_rung(rung, len(active), time.perf_counter() - t0)
+            ok = (err == 0) & ~ovf
+            crcs_out[active[ok]] = crc[ok]
+            resolved[active[ok]] = True
+            err_out[active] = err
+            still = self.capacity_flagged(err)
+            if not len(still):
+                break
+            cur = gather_corpus(cur, still)
+            active = active[still]
+        self._finalize(resolved)
+        return crcs_out, resolved, err_out
+
+    def _wirec_fn(self, rung: int, Wp: int, Ep: int, profile):
+        import jax.numpy as jnp
+
+        layout_r = self.rung_layout(rung)
+        key = ("wirec", self.layout, rung, Wp, Ep, profile, self._shards())
+
+        def build():
+            if self.mesh is not None:
+                from ..parallel.mesh import (
+                    replay_wirec_sharded_escalated_crc,
+                )
+                return lambda c: replay_wirec_sharded_escalated_crc(
+                    c, self.mesh, layout_r, self.layout)
+            from ..ops.replay import replay_wirec_escalated_crc
+            return lambda c: replay_wirec_escalated_crc(
+                jnp.asarray(c.slab), jnp.asarray(c.bases),
+                jnp.asarray(c.n_events), c.profile, layout_r, self.layout)
+
+        return self.variants.get(key, build, self.metrics)

@@ -52,11 +52,15 @@ class RebuildStats:
 
     device: int = 0
     oracle_fallback: int = 0
+    #: subset of `device` that resolved through the widened-K escalation
+    #: ladder (capacity-flagged histories that stayed on device)
+    ladder: int = 0
     kernel_errors: Dict[int, int] = field(default_factory=dict)
 
     def merge(self, other: "RebuildStats") -> None:
         self.device += other.device
         self.oracle_fallback += other.oracle_fallback
+        self.ladder += other.ladder
         for code, n in other.kernel_errors.items():
             self.kernel_errors[code] = self.kernel_errors.get(code, 0) + n
 
@@ -83,9 +87,11 @@ class DeviceRebuilder:
         import os
 
         from ..utils.metrics import DEFAULT_REGISTRY
+        from .ladder import EscalationLadder
         self.layout = layout
         self.stats = RebuildStats()
         self.metrics = DEFAULT_REGISTRY
+        self.ladder = EscalationLadder(layout, registry=self.metrics)
         #: max jobs per device launch (bounds the [W, E, L] corpus the
         #: same way the replay engine's chunking does)
         self.chunk_jobs = (chunk_jobs if chunk_jobs else
@@ -183,15 +189,26 @@ class DeviceRebuilder:
                 return [self._oracle_rebuild(b, e) for b, e in jobs]
             raise
 
-        out: List[MutableState] = []
+        from ..ops.state import CAPACITY_ERRORS
+
+        out: List[Optional[MutableState]] = []
+        #: capacity-flagged jobs: (position in `out`, batches, entry) —
+        #: re-replayed at widened K in ONE batched ladder pass below
+        #: instead of one oracle loop each
+        escalate: List[Tuple[int, Sequence[HistoryBatch],
+                             Optional[DomainEntry]]] = []
         for (lo, hi), (rows, arrs) in zip(spans, results):
             for i, (batches, entry) in enumerate(jobs[lo:hi]):
                 err = int(arrs.error[i])
                 if err != 0:
-                    self.stats.oracle_fallback += 1
-                    scope.inc(m.M_ORACLE_FALLBACKS)
                     self.stats.kernel_errors[err] = (
                         self.stats.kernel_errors.get(err, 0) + 1)
+                    if err in CAPACITY_ERRORS:
+                        escalate.append((len(out), batches, entry))
+                        out.append(None)
+                        continue
+                    self.stats.oracle_fallback += 1
+                    scope.inc(m.M_ORACLE_FALLBACKS)
                     out.append(self._oracle_rebuild(batches, entry))
                     continue
                 ms = self._hydrate(arrs, i, batches, entry)
@@ -207,6 +224,28 @@ class DeviceRebuilder:
                 self.stats.device += 1
                 scope.inc(m.M_DEVICE_REBUILDS)
                 out.append(ms)
+
+        if escalate:
+            corpus = encode_corpus(
+                [b for _, b, _ in escalate],
+                max(history_length(b) for _, b, _ in escalate))
+            outcome, states = self.ladder.escalate_states(corpus)
+            for k, (pos, batches, entry) in enumerate(escalate):
+                ms = None
+                if outcome.resolved[k]:
+                    arrs_k, row_k = states[k]
+                    ms = self._hydrate(arrs_k, row_k, batches, entry)
+                if (ms is not None
+                        and (payload_row(ms, self.layout)
+                             == outcome.rows[k]).all()):
+                    self.stats.device += 1
+                    self.stats.ladder += 1
+                    scope.inc(m.M_DEVICE_REBUILDS)
+                    out[pos] = ms
+                else:
+                    self.stats.oracle_fallback += 1
+                    scope.inc(m.M_ORACLE_FALLBACKS)
+                    out[pos] = self._oracle_rebuild(batches, entry)
         done = self.stats.device + self.stats.oracle_fallback
         self.metrics.gauge(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
                            (self.stats.oracle_fallback / done) if done else 0.0)

@@ -25,9 +25,13 @@ appended batch repacks only the suffix), and verify_all compares payload
 rows ON DEVICE, reading back a mismatch bitmap plus the error lanes
 instead of the full [W, width] tensor.
 
-Workflows whose histories exceed kernel capacities (pending tables, event
-length) or trip the error flag fall back to the per-workflow oracle path —
-measured and reported, never silent.
+Workflows whose histories exceed kernel capacities no longer fall off to
+the per-workflow oracle: capacity-flagged rows gather into a compact
+sub-corpus and re-replay ON DEVICE at widened K through the escalation
+ladder (engine/ladder.py; rung-1 dispatch rides the executor's escalate
+hook, overlapping later chunks' pack/replay). Only rows that still
+overflow at the top rung — or whose error no capacity can fix — arbitrate
+through the oracle, measured and reported under `tpu.fallback/*`.
 """
 from __future__ import annotations
 
@@ -52,6 +56,7 @@ from ..ops.encode import (
     NUM_LANES,
     assemble_corpus,
     encode_segments,
+    gather_subcorpus,
 )
 from ..ops.payload import payload_rows
 from ..ops.replay import replay_events, verify_rows
@@ -59,6 +64,7 @@ from ..utils import metrics as m
 from ..utils.profiler import ReplayProfiler
 from .cache import PackCache
 from .executor import BulkReplayExecutor
+from .ladder import EscalationLadder
 from .persistence import Stores
 
 #: max workflows per device launch on the bulk path; bounds peak host
@@ -80,8 +86,13 @@ class BulkVerifyResult:
     total: int
     verified_on_device: int
     divergent: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: keys arbitrated by the per-workflow oracle: the escalation
+    #: ladder's RESIDUE (top-rung overflow or non-capacity errors) —
+    #: before the ladder this held every device-flagged key
     fallback: List[Tuple[str, str, str]] = field(default_factory=list)
     device_errors: List[Tuple[Tuple[str, str, str], int]] = field(default_factory=list)
+    #: keys resolved ON DEVICE by the widened-K re-replay ladder
+    escalated: List[Tuple[str, str, str]] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -98,6 +109,7 @@ class TPUReplayEngine:
         self.stores = stores
         self.layout = layout
         self.pack_cache = PackCache()
+        self.ladder = EscalationLadder(layout)
         self.metrics = m.DEFAULT_REGISTRY
         self.chunk_workflows = (chunk_workflows if chunk_workflows
                                 else int(os.environ.get(CHUNK_ENV,
@@ -120,6 +132,7 @@ class TPUReplayEngine:
         cluster's /metrics scrape."""
         self._metrics = registry
         self.pack_cache.metrics = registry
+        self.ladder.metrics = registry
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [
@@ -195,13 +208,19 @@ class TPUReplayEngine:
         return corpus
 
     def _run_chunks(self, keys: List[Tuple[str, str, str]], pack_extra,
-                    launch_fn, readback_fn):
+                    launch_fn, readback_fn, escalate_fn=None):
         """Drive the pipelined executor over key chunks.
 
         pack_extra(chunk_keys) -> host-side extras packed alongside the
         corpus (runs in the pack pool, overlapped with device compute);
         launch_fn(corpus_dev, extras) -> device outs (async);
-        readback_fn(outs) -> numpy results per chunk.
+        readback_fn(outs) -> numpy results per chunk;
+        escalate_fn(ci, corpus_np, consumed) -> consumed — optional
+        capacity-escalation seam: called right after chunk ci's readback
+        with its HOST corpus (held only until then — at most `depth`
+        corpora are ever retained, the ring bound), so flagged rows can
+        gather and dispatch widened re-replays while later chunks still
+        pack and replay.
         Returns (per-chunk results, per-chunk real-event counts)."""
         spans = self._chunk_spans(len(keys))
         pad_to = min(max(1, self.chunk_workflows), len(keys))
@@ -211,6 +230,7 @@ class TPUReplayEngine:
                                       registry=self.metrics)
         shapes: List[Optional[Tuple[int, int]]] = [None] * len(spans)
         events: List[int] = [0] * len(spans)
+        corpora: dict = {}
 
         def pack(ci):
             lo, hi = spans[ci]
@@ -218,6 +238,8 @@ class TPUReplayEngine:
             corpus = self._pack_chunk(chunk_keys, pad_to)
             shapes[ci] = (corpus.shape[0], corpus.shape[1])
             events[ci] = int((corpus[:, :, LANE_EVENT_ID] > 0).sum())
+            if escalate_fn is not None:
+                corpora[ci] = corpus
             extras = pack_extra(chunk_keys) if pack_extra else None
             return corpus, extras
 
@@ -236,9 +258,13 @@ class TPUReplayEngine:
             with prof.leg(m.M_PROFILE_READBACK):
                 return readback_fn(outs)
 
+        def escalate(ci, consumed):
+            return escalate_fn(ci, corpora.pop(ci), consumed)
+
         with scope.timed():
-            results, _report = executor.run(len(spans), pack, launch,
-                                            consume)
+            results, _report = executor.run(
+                len(spans), pack, launch, consume,
+                escalate if escalate_fn is not None else None)
         self.last_run_chunk_shapes = [s for s in shapes if s is not None]
         t = self.metrics.timer(m.SCOPE_TPU_REPLAY, m.M_LATENCY)
         if t.total_s > 0:
@@ -290,13 +316,25 @@ class TPUReplayEngine:
         mutable states (zero-divergence contract). The compare itself runs
         ON DEVICE: expected payload rows ship with the corpus and the host
         reads back a mismatch bitmap plus the error lanes — not the full
-        [W, width] payload tensor. Errored rows are re-run through the
-        oracle (per-workflow fallback path), exactly as before."""
+        [W, width] payload tensor.
+
+        Capacity-flagged rows (pending-table / version-history / branch
+        overflow) escalate through the widened-K ladder: their rung-1
+        re-replay is DISPATCHED from the executor's escalate hook as each
+        chunk's errors read back — overlapping later chunks — and rungs
+        ≥ 2 run once, batched across all chunks' survivors. Rows the
+        ladder resolves verify against the live state at the base payload
+        width, byte-identically to the oracle; only the ladder's residue
+        (plus non-capacity errors) re-runs through the per-workflow
+        oracle."""
         if keys is None:
             keys = self.stores.execution.list_executions()
         keys = list(keys)
         if not keys:
             return BulkVerifyResult(total=0, verified_on_device=0)
+        spans = self._chunk_spans(len(keys))
+        #: ci -> (capacity-flagged local indices, pending rung-1 dispatch)
+        pending: dict = {}
 
         def pack_extra(chunk_keys):
             expected = np.zeros((len(chunk_keys), self.layout.width),
@@ -330,19 +368,51 @@ class TPUReplayEngine:
             mismatch = verify_rows(rows_dev, jnp.asarray(expected),
                                    state.current_branch,
                                    jnp.asarray(exp_branch))
-            return mismatch, state.error, expected
+            return mismatch, state.error, expected, exp_branch
 
         def readback(outs):
-            mismatch_dev, err_dev, expected = outs
-            return np.asarray(mismatch_dev), np.asarray(err_dev), expected
+            mismatch_dev, err_dev, expected, exp_branch = outs
+            return (np.asarray(mismatch_dev), np.asarray(err_dev),
+                    expected, exp_branch)
 
-        results, spans = self._run_chunks(keys, pack_extra, launch, readback)
+        def escalate(ci, corpus, consumed):
+            _mismatch, errors, _expected, _exp_branch = consumed
+            lo, hi = spans[ci]
+            cap = self.ladder.capacity_flagged(errors[:hi - lo])
+            if len(cap):
+                pending[ci] = (cap, self.ladder.submit(
+                    gather_subcorpus(corpus, cap)))
+            return consumed
+
+        results, spans = self._run_chunks(keys, pack_extra, launch,
+                                          readback, escalate)
+        ordered = sorted(pending.items())
+        outcomes = self.ladder.finish([p for _, (_, p) in ordered])
+        resolved = {}  # (ci, local j) -> (base-width ladder row, branch)
+        for (ci, (cap, _)), outcome in zip(ordered, outcomes):
+            for k, j in enumerate(cap):
+                if outcome.resolved[k]:
+                    resolved[(ci, int(j))] = (outcome.rows[k],
+                                              outcome.branch[k])
 
         result = BulkVerifyResult(total=len(keys), verified_on_device=0)
-        for (lo, hi), (mismatch, errors, expected) in zip(spans, results):
+        for ci, ((lo, hi), (mismatch, errors, expected, exp_branch)
+                 ) in enumerate(zip(spans, results)):
             for j, key in enumerate(keys[lo:hi]):
-                if errors[j] != 0:
-                    # device flagged this workflow: oracle fallback
+                if errors[j] != 0 and (ci, j) in resolved:
+                    # the widened-K re-replay cleared the capacity flag:
+                    # this row verified on device, no oracle involved.
+                    # Same contract as verify_rows: payload rows AND the
+                    # device-chosen branch must match the live state
+                    result.verified_on_device += 1
+                    result.escalated.append(key)
+                    rows_l, branch_l = resolved[(ci, j)]
+                    if (not (rows_l == expected[j]).all()
+                            or branch_l != exp_branch[j]):
+                        result.divergent.append(key)
+                elif errors[j] != 0:
+                    # top-rung overflow or a non-capacity error: the
+                    # per-workflow oracle arbitrates, as before
                     result.device_errors.append((key, int(errors[j])))
                     result.fallback.append(key)
                     oracle_ms = StateBuilder().replay_history(

@@ -222,6 +222,32 @@ def assemble_corpus(rows_list: Sequence[np.ndarray],
     return out
 
 
+def gather_subcorpus(events: np.ndarray, indices,
+                     pad_workflows: int = 0,
+                     pad_events: int = 0) -> np.ndarray:
+    """Gather flagged rows of a packed [W, E, L] corpus into a compact
+    [F', E', L] sub-corpus for widened-K re-replay (engine/ladder.py).
+
+    The event axis is trimmed to the FLAGGED rows' longest real history
+    (the whole point of the gather: a 2.7% flagged fraction re-replays a
+    ~2.7%-sized corpus, not the original), then padded up to `pad_events`;
+    the workflow axis pads up to `pad_workflows`. Padding rows/slots are
+    no-op lanes (event_type -1, id 0 — the kernel skips them), so padded
+    shapes can be pow2-bucketed for executable reuse without changing any
+    real row's result."""
+    idx = np.asarray(indices, dtype=np.int64)
+    sub = events[idx]
+    real = sub[:, :, LANE_EVENT_ID] > 0
+    e_real = (int(real.any(axis=0).nonzero()[0].max()) + 1
+              if real.any() else 1)
+    E = max(e_real, pad_events)
+    W = max(len(idx), pad_workflows)
+    out = np.zeros((W, E, NUM_LANES), dtype=np.int64)
+    out[:, :, LANE_EVENT_TYPE] = -1
+    out[:len(idx), :e_real] = sub[:, :e_real]
+    return out
+
+
 def encode_chain(runs: Sequence[Sequence[HistoryBatch]],
                  max_events: int) -> np.ndarray:
     """Pack a continue-as-new chain (a list of runs, each a list of batches)

@@ -25,9 +25,34 @@ def _count(occ: jnp.ndarray) -> jnp.ndarray:
 
 def payload_rows(s: ReplayState, layout: PayloadLayout = DEFAULT_LAYOUT) -> jnp.ndarray:
     """[W, layout.width] int64 canonical payload, comparable elementwise with
-    the oracle's payload_row output."""
+    the oracle's payload_row output. One implementation serves both this
+    and the escalation ladder's narrowing (the canonical field order must
+    never fork): at the state's own layout the projection slices are
+    no-ops and XLA dead-code-eliminates the unused overflow mask."""
+    rows, _overflow = payload_rows_narrow(s, layout)
+    return rows
+
+
+def payload_rows_narrow(s: ReplayState, out_layout: PayloadLayout
+                        ) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """Project a (possibly widened-K) state's canonical payload down to
+    `out_layout`'s width — the escalation ladder's readback (engine/
+    ladder.py): a flagged row re-replayed at 2K/4K must still hash to the
+    BASE payload the oracle and stored checksums use.
+
+    Returns (rows [W, out_layout.width], overflow [W] bool). Sorted
+    pending lists put PAD past the occupied count, and the version-history
+    tables are PAD-filled past vh_count, so truncating each block to the
+    out capacity is exact whenever the FINAL counts fit. Rows whose final
+    counts exceed an out capacity are unrepresentable in the canonical
+    payload (the oracle's payload_row raises OverflowError on them too)
+    and come back with `overflow` set — widening further never fixes
+    those, only oracle arbitration can.
+
+    With out_layout equal to the state's own layout this is elementwise
+    identical to payload_rows (tests assert)."""
     W = s.state.shape[0]
-    Kv = layout.max_version_history_items
+    Kv = out_layout.max_version_history_items
     scalars = jnp.stack(
         [
             s.cancel_requested.astype(jnp.int64),
@@ -44,31 +69,38 @@ def payload_rows(s: ReplayState, layout: PayloadLayout = DEFAULT_LAYOUT) -> jnp.
         ],
         axis=1,
     )
-    # the canonical payload covers the CURRENT branch only (checksum.go:92);
-    # gather it out of the per-branch tables
     bidx = s.current_branch.astype(jnp.int32)
     vh_event_ids = jnp.take_along_axis(
         s.vh_event_ids, bidx[:, None, None], axis=1).squeeze(1)
     vh_versions = jnp.take_along_axis(
         s.vh_versions, bidx[:, None, None], axis=1).squeeze(1)
-    vh_count = jnp.take_along_axis(s.vh_count, bidx[:, None], axis=1).squeeze(1)
-    # interleave (event_id, version) pairs; slots beyond vh_count are PAD-filled
-    vh_pairs = jnp.stack([vh_event_ids, vh_versions], axis=2).reshape(W, 2 * Kv)
-    parts = [
-        scalars,
-        vh_count.astype(jnp.int64)[:, None],
-        vh_pairs,
-        _count(s.timers.occ)[:, None],
-        _sorted_ids(s.timers.occ, s.timers.started_id),
-        _count(s.activities.occ)[:, None],
-        _sorted_ids(s.activities.occ, s.activities.schedule_id),
-        _count(s.children.occ)[:, None],
-        _sorted_ids(s.children.occ, s.children.initiated_id),
-        _count(s.signals.occ)[:, None],
-        _sorted_ids(s.signals.occ, s.signals.initiated_id),
-        _count(s.cancels.occ)[:, None],
-        _sorted_ids(s.cancels.occ, s.cancels.initiated_id),
-    ]
-    rows = jnp.concatenate(parts, axis=1)
-    assert rows.shape[1] == layout.width, (rows.shape, layout.width)
-    return rows
+    vh_count = jnp.take_along_axis(s.vh_count, bidx[:, None],
+                                   axis=1).squeeze(1)
+    overflow = vh_count.astype(jnp.int64) > Kv
+    vh_pairs = jnp.stack(
+        [vh_event_ids[:, :Kv], vh_versions[:, :Kv]], axis=2
+    ).reshape(W, 2 * Kv)
+
+    def narrowed(occ, ids, cap):
+        nonlocal overflow
+        cnt = _count(occ)
+        overflow = overflow | (cnt > cap)
+        return cnt[:, None], _sorted_ids(occ, ids)[:, :cap]
+
+    t_cnt, t_ids = narrowed(s.timers.occ, s.timers.started_id,
+                            out_layout.max_timers)
+    a_cnt, a_ids = narrowed(s.activities.occ, s.activities.schedule_id,
+                            out_layout.max_activities)
+    c_cnt, c_ids = narrowed(s.children.occ, s.children.initiated_id,
+                            out_layout.max_children)
+    sg_cnt, sg_ids = narrowed(s.signals.occ, s.signals.initiated_id,
+                              out_layout.max_signals)
+    rc_cnt, rc_ids = narrowed(s.cancels.occ, s.cancels.initiated_id,
+                              out_layout.max_request_cancels)
+    rows = jnp.concatenate([
+        scalars, vh_count.astype(jnp.int64)[:, None], vh_pairs,
+        t_cnt, t_ids, a_cnt, a_ids, c_cnt, c_ids, sg_cnt, sg_ids,
+        rc_cnt, rc_ids,
+    ], axis=1)
+    assert rows.shape[1] == out_layout.width, (rows.shape, out_layout.width)
+    return rows, overflow

@@ -160,6 +160,56 @@ def replay_wirec_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
     return crc32_rows(payload_rows(s, layout)), s.error
 
 
+@partial(jax.jit, static_argnames=("layout", "out_layout"))
+def replay_escalated(events: jnp.ndarray, layout: PayloadLayout,
+                     out_layout: PayloadLayout = DEFAULT_LAYOUT
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                jnp.ndarray]:
+    """One escalation rung: re-replay a flagged sub-corpus [F, E, L] at a
+    WIDENED capacity `layout` (engine/ladder.py doubles K per rung) and
+    project the canonical payload back down to `out_layout` — the base
+    width the oracle and stored checksums use. Returns (rows
+    [F, out_width], error [F], narrow_overflow [F], current_branch [F]);
+    a row is resolved when error == 0 and narrow_overflow is unset."""
+    from .payload import payload_rows_narrow
+
+    s = replay_events(events, layout)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return rows, s.error, ovf, s.current_branch
+
+
+@partial(jax.jit, static_argnames=("layout", "out_layout"))
+def replay_escalated_state(events: jnp.ndarray, layout: PayloadLayout,
+                           out_layout: PayloadLayout = DEFAULT_LAYOUT):
+    """Ladder rung variant that also returns the full widened ReplayState:
+    the rebuild path (engine/rebuild.py) hydrates pending tables straight
+    out of the widened state's occupied slots."""
+    from .payload import payload_rows_narrow
+
+    s = replay_events(events, layout)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return s, rows, s.error, ovf
+
+
+@partial(jax.jit, static_argnames=("profile", "layout", "out_layout"))
+def replay_wirec_escalated_crc(slab: jnp.ndarray, bases: jnp.ndarray,
+                               n_events: jnp.ndarray, profile,
+                               layout: PayloadLayout,
+                               out_layout: PayloadLayout = DEFAULT_LAYOUT
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                          jnp.ndarray]:
+    """Escalation rung over a wirec-compressed flagged sub-corpus: decode
+    + widened replay + base-width payload + CRC32 all on device — the
+    bulk-bench fallback leg's configuration (4 bytes/flagged-row back).
+    Returns (crc32 [F] uint32, error [F], narrow_overflow [F])."""
+    from .crc import crc32_rows
+    from .payload import payload_rows_narrow
+
+    s = replay_wirec(slab, bases, n_events, profile, layout)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return crc32_rows(rows), s.error, ovf
+
+
 @jax.jit
 def verify_rows(rows: jnp.ndarray, expected_rows: jnp.ndarray,
                 branch: jnp.ndarray, expected_branch: jnp.ndarray

@@ -154,6 +154,34 @@ class ErrorCode:
     BAD_FORK = 14
 
 
+#: error codes a widened-K re-replay can clear (engine/ladder.py): the
+#: history is valid, the kernel's fixed capacities just weren't enough.
+#: Every other code is a genuine history error no capacity would fix —
+#: those go straight to oracle arbitration.
+CAPACITY_ERRORS = (
+    ErrorCode.VERSION_HISTORY_OVERFLOW,
+    ErrorCode.TABLE_OVERFLOW,
+    ErrorCode.BRANCH_OVERFLOW,
+)
+
+
+def widen_layout(layout: PayloadLayout, factor: int) -> PayloadLayout:
+    """The escalation-rung layout: every kernel capacity multiplied by
+    `factor` (the reference's pending maps are unbounded Go maps —
+    mutable_state_builder.go — so capacity pressure is purely a device
+    artifact; doubling K per rung keeps flagged rows on device instead
+    of falling off to the per-workflow Python oracle)."""
+    return PayloadLayout(
+        max_version_history_items=layout.max_version_history_items * factor,
+        max_activities=layout.max_activities * factor,
+        max_timers=layout.max_timers * factor,
+        max_children=layout.max_children * factor,
+        max_request_cancels=layout.max_request_cancels * factor,
+        max_signals=layout.max_signals * factor,
+        max_branches=layout.max_branches * factor,
+    )
+
+
 def init_state(num_workflows: int, layout: PayloadLayout = DEFAULT_LAYOUT) -> ReplayState:
     """Fresh state for W workflows, matching the oracle's ExecutionInfo
     defaults (oracle/mutable_state.py ExecutionInfo / NewMutableStateBuilder)."""

@@ -306,6 +306,31 @@ def pack_wirec(events64: np.ndarray,
     return WirecCorpus(slab, bases, n, profile)
 
 
+def gather_corpus(corpus: WirecCorpus, indices,
+                  pad_workflows: int = 0,
+                  pad_events: int = 0) -> WirecCorpus:
+    """Gather flagged rows into a compact sub-corpus under the SAME
+    profile (engine/ladder.py's wirec leg): the widened-K re-replay
+    decodes the identical bytes, so gather+re-replay is byte-equivalent
+    to the rows' original decode. The event axis trims to the flagged
+    rows' longest real history; padding rows carry n_events = 0 (the
+    decoder masks every event past n_events to no-op lanes), letting
+    padded shapes pow2-bucket for executable reuse."""
+    idx = np.asarray(indices, dtype=np.int64)
+    n = corpus.n_events[idx]
+    e_real = int(n.max()) if len(idx) else 1
+    e_real = max(e_real, 1)
+    E = max(e_real, pad_events)
+    W = max(len(idx), pad_workflows)
+    slab = np.zeros((W, E, corpus.slab.shape[2]), dtype=np.uint8)
+    bases = np.zeros((W, corpus.bases.shape[1]), dtype=np.int64)
+    n_events = np.zeros((W,), dtype=np.int32)
+    slab[:len(idx), :e_real] = corpus.slab[idx][:, :e_real]
+    bases[:len(idx)] = corpus.bases[idx]
+    n_events[:len(idx)] = n
+    return WirecCorpus(slab, bases, n_events, corpus.profile)
+
+
 # ---------------------------------------------------------------------------
 # Device decode (pure jnp; exact inverse of pack_wirec)
 # ---------------------------------------------------------------------------

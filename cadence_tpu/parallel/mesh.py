@@ -16,6 +16,7 @@ pipeline analog, see SURVEY.md §2.6).
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -41,9 +42,6 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
 def shard_events(events: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
     """Place [W, E, L] events with W partitioned over the 'shard' axis."""
     return jax.device_put(events, NamedSharding(mesh, P(SHARD_AXIS, None, None)))
-
-
-from functools import partial
 
 
 @partial(jax.jit, static_argnames=("layout",))
@@ -134,3 +132,41 @@ def replay_wirec_sharded_crc(corpus, mesh: Mesh,
     slab, bases, n_events = shard_wirec(corpus, mesh)
     return _replay_wirec_crc_with_stats(slab, bases, n_events,
                                         corpus.profile, layout)
+
+
+# ---------------------------------------------------------------------------
+# Capacity-escalation rungs under the shard axis (engine/ladder.py): the
+# flagged-row sub-corpus re-replays at widened K partitioned over the SAME
+# 'shard' axis as the primary replay — capacity pressure stays SPMD on
+# device instead of funnelling flagged rows to a per-workflow host oracle.
+# The sub-corpus is padded to a multiple of the mesh size (padding rows
+# are no-op lanes), so every shard re-replays its slice of the flagged set.
+# ---------------------------------------------------------------------------
+
+
+def replay_sharded_escalated(events: jnp.ndarray, mesh: Mesh,
+                             layout: PayloadLayout,
+                             out_layout: PayloadLayout = DEFAULT_LAYOUT
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                        jnp.ndarray, jnp.ndarray]:
+    """SPMD widened-K re-replay of a flagged sub-corpus; returns (rows
+    [F, out_width] at the BASE payload width, errors [F], narrow-overflow
+    [F], current branch [F]), all sharded over 'shard'."""
+    from ..ops.replay import replay_escalated
+
+    events = shard_events(events, mesh)
+    return replay_escalated(events, layout, out_layout)
+
+
+def replay_wirec_sharded_escalated_crc(corpus, mesh: Mesh,
+                                       layout: PayloadLayout,
+                                       out_layout: PayloadLayout = DEFAULT_LAYOUT
+                                       ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                  jnp.ndarray]:
+    """SPMD widened-K wirec re-replay reduced to (crc32 [F] uint32 at the
+    base payload width, errors [F], narrow-overflow [F])."""
+    from ..ops.replay import replay_wirec_escalated_crc
+
+    slab, bases, n_events = shard_wirec(corpus, mesh)
+    return replay_wirec_escalated_crc(slab, bases, n_events,
+                                      corpus.profile, layout, out_layout)

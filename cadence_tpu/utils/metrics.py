@@ -40,6 +40,7 @@ SCOPE_REPLICATION = "replication.task-processor"
 SCOPE_TPU_REPLAY = "tpu.replay-engine"
 SCOPE_REBUILD = "tpu.device-rebuilder"
 SCOPE_PACK_CACHE = "tpu.pack-cache"
+SCOPE_TPU_FALLBACK = "tpu.fallback"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -80,12 +81,34 @@ M_PROFILE_READBACK = "readback"
 #: (engine/executor.py): non-zero p50 here means the host packers are
 #: starving the device; a near-zero leg means the device is the bottleneck
 M_PROFILE_PACK_WAIT = "pack-queue-wait"
+#: capacity-escalation leg (engine/ladder.py): gather + widened-K
+#: re-replay of flagged rows; replaces the per-workflow oracle leg on
+#: capacity overflow, so this leg growing while oracle fallbacks stay
+#: flat is the ladder working as intended
+M_PROFILE_FALLBACK = "fallback"
 M_H2D_BYTES = "h2d-bytes"
 #: pack-cache counters (engine/cache.py PackCache, SCOPE_PACK_CACHE)
 M_CACHE_HITS = "hits"
 M_CACHE_MISSES = "misses"
 M_CACHE_EVICTIONS = "evictions"
 M_CACHE_SUFFIX_PACKS = "suffix-packs"
+#: capacity-escalation ladder counters (engine/ladder.py,
+#: SCOPE_TPU_FALLBACK): rows entering the ladder, rows re-replayed at
+#: each rung (metric name ladder_rung_rows(r)), rows resolved on device,
+#: rows left for oracle arbitration, widened-kernel compiles, and the
+#: kernel-variant cache hits/misses that prove a warm run recompiled
+#: nothing (utils/compile_cache.KernelVariantCache)
+M_LADDER_FLAGGED = "flagged-rows"
+M_LADDER_RESOLVED = "resolved-rows"
+M_LADDER_RESIDUAL = "residual-oracle-rows"
+M_LADDER_COMPILES = "rung-compiles"
+M_LADDER_CACHE_HITS = "compile-cache-hits"
+M_LADDER_CACHE_MISSES = "compile-cache-misses"
+
+
+def ladder_rung_rows(rung: int) -> str:
+    """Per-rung row counter name: rows-rung1, rows-rung2, ..."""
+    return f"rows-rung{rung}"
 
 
 #: latency buckets (seconds): sub-ms sync paths through multi-second

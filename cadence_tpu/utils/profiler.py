@@ -13,6 +13,9 @@ ops/replay.replay_corpus) wraps its phases in a ReplayProfiler:
   h2d             — host→device transfer dispatch (+ bytes, M_H2D_BYTES)
   kernel          — device replay compute, measured to block_until_ready
   readback        — device→host pull of payload rows / CRCs / errors
+  fallback        — capacity-escalation ladder (engine/ladder.py): gather
+                    + widened-K re-replay of overflow-flagged rows; the
+                    batched replacement for the per-workflow oracle leg
 
 Legs land as histograms under the component's scope (SCOPE_TPU_REPLAY by
 default, SCOPE_REBUILD for the rebuilder), so `/metrics` scrapes, the
@@ -28,7 +31,7 @@ from . import metrics as m
 
 #: the leg metric names, in pipeline order
 LEGS = (m.M_PROFILE_PACK, m.M_PROFILE_PACK_WAIT, m.M_PROFILE_H2D,
-        m.M_PROFILE_KERNEL, m.M_PROFILE_READBACK)
+        m.M_PROFILE_KERNEL, m.M_PROFILE_READBACK, m.M_PROFILE_FALLBACK)
 
 
 class ReplayProfiler:

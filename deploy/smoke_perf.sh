@@ -1,10 +1,17 @@
 #!/usr/bin/env bash
 # Perf smoke: run the SMALL bench suite through the pipelined bulk
 # executor, write the JSON next to the recorded BENCH_r*.json trajectory
-# (PERF_smoke.json), and FAIL unless crc_parity_wire32 (and the
-# pipelined-path parity) hold and every suite's transfer_included_rate
-# stays within PERF_TOLERANCE (default 0.5x) of the recorded baseline —
-# by default the newest BENCH_r*.json, overridable with the first arg.
+# (PERF_smoke.json), and FAIL unless:
+#   - crc_parity_wire32 (and the pipelined-path parity) hold;
+#   - every suite's transfer_included_rate stays within PERF_TOLERANCE
+#     (default 0.5x) of the recorded baseline — by default the newest
+#     BENCH_r*.json, overridable with the first arg;
+#   - the fallback-under-pressure gate holds: the capacity-escalation
+#     ladder's arbitration stays CRC-identical to the oracle-only path,
+#     warm trials recompile nothing, and fallback_under_pressure
+#     .mixed_rate_median stays within PERF_TOLERANCE of the baseline's —
+#     CI catches a reintroduced overflow cliff (BENCH_r05's 3x collapse)
+#     right here.
 # The assertions live in tests/test_perf_gate.py, marked `perf`.
 #
 # Usage: deploy/smoke_perf.sh [baseline.json] [extra pytest args]

@@ -1,0 +1,340 @@
+"""Capacity-escalation ladder (ISSUE 5).
+
+Covers: flagged-row gather round-trip parity; the narrow payload
+projection (widened state → base width, elementwise identical at equal
+layouts); rung-1 resolution byte-identical to the oracle; rows that
+overflow EVERY rung still arbitrating through the oracle byte-identically
+(engine verify path included); rung/compile/residual counters visible on
+/metrics; escalation under the pipelined executor at depth ≥ 2; the
+rebuild path hydrating from widened rung states; the wirec ladder's CRC
+parity; and the kernel-variant cache proving warm escalations recompile
+nothing.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cadence_tpu.core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    crc32_of_row,
+    payload_row,
+)
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.engine.ladder import EscalationLadder
+from cadence_tpu.engine.persistence import Stores
+from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+from cadence_tpu.gen.corpus import (
+    HistoryWriter,
+    OVERFLOW_FRACTION,
+    gen_overflow,
+    generate_corpus,
+)
+from cadence_tpu.ops.encode import (
+    LANE_EVENT_ID,
+    encode_corpus,
+    gather_subcorpus,
+)
+from cadence_tpu.ops.payload import payload_rows, payload_rows_narrow
+from cadence_tpu.ops.replay import replay_events
+from cadence_tpu.ops.state import CAPACITY_ERRORS, ErrorCode, widen_layout
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.utils import metrics as m
+from cadence_tpu.utils.compile_cache import KernelVariantCache
+
+SEED = 20260730
+
+
+def _flood_seed() -> int:
+    """A seed whose first random() lands in the flood branch."""
+    s = 0
+    while random.Random(s).random() >= OVERFLOW_FRACTION:
+        s += 1
+    return s
+
+
+def _flood_history(capacity_hint: int, wf: str = "flood"):
+    """One history holding capacity_hint + 8 concurrently-pending
+    activities mid-replay (drained before close, so the ORACLE's final
+    payload is representable at the base layout)."""
+    rng = random.Random(_flood_seed())
+    w = HistoryWriter(workflow_id=wf, run_id=f"run-{wf}")
+    gen_overflow(rng, w, target_events=40, capacity_hint=capacity_hint)
+    assert w._open is None
+    return w.batches
+
+
+def _overflow_setup(n=256, target=80):
+    hists = generate_corpus("overflow", num_workflows=n, seed=SEED,
+                            target_events=target)
+    events = encode_corpus(hists)
+    state = replay_events(jnp.asarray(events))
+    errors = np.asarray(state.error)
+    return hists, events, state, errors
+
+
+def _oracle_row(history):
+    row = payload_row(StateBuilder().replay_history(history))
+    row[STICKY_ROW_INDEX] = 0
+    return row
+
+
+class TestGatherAndNarrow:
+    def test_gather_subcorpus_roundtrip(self):
+        """Gathered rows replay to EXACTLY the outputs the same rows had
+        inside the full corpus — the gather loses nothing."""
+        hists, events, state, errors = _overflow_setup(n=128)
+        full_rows = np.asarray(payload_rows(state))
+        idx = np.asarray([0, 5, 17, 99])
+        sub = gather_subcorpus(events, idx, pad_workflows=8, pad_events=0)
+        assert sub.shape[0] == 8
+        # the event axis trims to the gathered rows' longest real history
+        assert sub.shape[1] == int(
+            (events[idx][:, :, LANE_EVENT_ID] > 0).sum(axis=1).max())
+        s2 = replay_events(jnp.asarray(sub))
+        assert (np.asarray(s2.error)[:4] == errors[idx]).all()
+        rows2 = np.asarray(payload_rows(s2))
+        healthy = errors[idx] == 0
+        assert (rows2[:4][healthy] == full_rows[idx][healthy]).all()
+        # padding rows replay as no-ops: no error, untouched fresh state
+        assert (np.asarray(s2.error)[4:] == 0).all()
+
+    def test_narrow_equals_payload_rows_at_same_layout(self):
+        _, _, state, errors = _overflow_setup(n=64)
+        rows = np.asarray(payload_rows(state))
+        rows_n, ovf = payload_rows_narrow(state, DEFAULT_LAYOUT)
+        assert (np.asarray(rows_n) == rows).all()
+        assert not np.asarray(ovf).any()
+
+    def test_narrow_from_widened_state_matches_oracle(self):
+        """Replay at 2K, project to base width: byte-identical to the
+        oracle's base-layout payload for rows that fit."""
+        hists, events, _, errors = _overflow_setup(n=128)
+        flagged = np.nonzero(errors)[0]
+        assert len(flagged) > 0
+        wide = widen_layout(DEFAULT_LAYOUT, 2)
+        sub = gather_subcorpus(events, flagged)
+        s = replay_events(jnp.asarray(sub), wide)
+        assert (np.asarray(s.error) == 0).all()
+        rows_n, ovf = payload_rows_narrow(s, DEFAULT_LAYOUT)
+        assert not np.asarray(ovf).any()
+        for k, i in enumerate(flagged):
+            assert (np.asarray(rows_n)[k] == _oracle_row(hists[i])).all()
+
+    def test_narrow_overflow_flags_unrepresentable_final_state(self):
+        """A FINAL state wider than the base payload can never narrow —
+        the overflow mask says so instead of truncating silently."""
+        w = HistoryWriter(workflow_id="wide-final", run_id="r")
+        w.begin_batch()
+        w.add(EventType.WorkflowExecutionStarted,
+              execution_start_to_close_timeout_seconds=600,
+              task_start_to_close_timeout_seconds=10)
+        w.end_batch()
+        w.begin_batch()
+        w.add(EventType.DecisionTaskScheduled,
+              start_to_close_timeout_seconds=10)
+        w.end_batch()
+        started = w.single(EventType.DecisionTaskStarted,
+                           scheduled_event_id=2)
+        w.begin_batch()
+        completed = w.add(EventType.DecisionTaskCompleted,
+                          scheduled_event_id=2, started_event_id=started.id)
+        for i in range(DEFAULT_LAYOUT.max_activities + 4):
+            w.add(EventType.ActivityTaskScheduled, activity_id=f"a-{i}",
+                  task_list="tl", schedule_to_start_timeout_seconds=60,
+                  schedule_to_close_timeout_seconds=120,
+                  start_to_close_timeout_seconds=60,
+                  heartbeat_timeout_seconds=0)
+        w.end_batch()
+        events = encode_corpus([w.batches])
+        wide = widen_layout(DEFAULT_LAYOUT, 2)
+        s = replay_events(jnp.asarray(events), wide)
+        assert int(np.asarray(s.error)[0]) == 0  # fits at 2K
+        _, ovf = payload_rows_narrow(s, DEFAULT_LAYOUT)
+        assert bool(np.asarray(ovf)[0])
+
+
+class TestLadderCore:
+    def test_rung1_resolves_default_overflow_suite(self):
+        hists, events, _, errors = _overflow_setup(n=256)
+        flagged = np.nonzero(errors)[0]
+        assert len(flagged) >= 4
+        assert set(errors[flagged]) == {ErrorCode.TABLE_OVERFLOW}
+        ladder = EscalationLadder(DEFAULT_LAYOUT)
+        outcome = ladder.escalate(gather_subcorpus(events, flagged))
+        assert outcome.resolved.all()
+        assert [r["rung"] for r in outcome.rungs] == [1]
+        for k, i in enumerate(flagged):
+            assert (outcome.rows[k] == _oracle_row(hists[i])).all()
+
+    def test_rung2_resolves_what_rung1_cannot(self):
+        """A flood past 2K but under 4K climbs to rung 2 and resolves."""
+        hint = DEFAULT_LAYOUT.max_activities * 2  # flood = 2K + 8 > 2K
+        hists = [_flood_history(hint)]
+        events = encode_corpus(hists)
+        errors = np.asarray(replay_events(jnp.asarray(events)).error)
+        assert errors[0] == ErrorCode.TABLE_OVERFLOW
+        ladder = EscalationLadder(DEFAULT_LAYOUT, max_rungs=2)
+        outcome = ladder.escalate(gather_subcorpus(events, [0]))
+        assert outcome.resolved[0]
+        assert [r["rung"] for r in outcome.rungs] == [1, 2]
+        assert (outcome.rows[0] == _oracle_row(hists[0])).all()
+
+    def test_top_rung_overflow_stays_residual(self):
+        """A flood past the TOP rung never resolves on device — the
+        outcome says so and the caller's oracle arbitration still
+        produces the byte-identical payload."""
+        hint = DEFAULT_LAYOUT.max_activities * 4  # flood > top rung (4K)
+        hists = [_flood_history(hint)]
+        events = encode_corpus(hists)
+        ladder = EscalationLadder(DEFAULT_LAYOUT, max_rungs=2)
+        outcome = ladder.escalate(gather_subcorpus(events, [0]))
+        assert not outcome.resolved[0]
+        assert outcome.errors[0] == ErrorCode.TABLE_OVERFLOW
+        # oracle arbitration of the residue: drained before close, so the
+        # final payload IS representable at base width
+        row = _oracle_row(hists[0])
+        assert row.shape[0] == DEFAULT_LAYOUT.width
+
+    def test_counters_reach_metrics_scrape(self):
+        hists, events, _, errors = _overflow_setup(n=128)
+        flagged = np.nonzero(errors)[0]
+        registry = m.MetricsRegistry()
+        ladder = EscalationLadder(DEFAULT_LAYOUT, registry=registry,
+                                  variants=KernelVariantCache())
+        ladder.variants.metrics = registry
+        ladder.escalate(gather_subcorpus(events, flagged))
+        snap = registry.snapshot()[m.SCOPE_TPU_FALLBACK]
+        assert snap[m.M_LADDER_FLAGGED] == len(flagged)
+        assert snap[m.ladder_rung_rows(1)] == len(flagged)
+        assert snap[m.M_LADDER_RESOLVED] == len(flagged)
+        assert snap[m.M_LADDER_RESIDUAL] == 0
+        assert snap[m.M_LADDER_COMPILES] >= 1
+        prom = registry.to_prometheus()
+        assert 'cadence_rows_rung1_total{scope="tpu.fallback"}' in prom
+        assert 'cadence_rung_compiles_total{scope="tpu.fallback"}' in prom
+        assert ('cadence_residual_oracle_rows_total{scope="tpu.fallback"}'
+                in prom)
+
+    def test_warm_escalation_pays_zero_recompiles(self):
+        _, events, _, errors = _overflow_setup(n=128)
+        flagged = np.nonzero(errors)[0]
+        registry = m.MetricsRegistry()
+        ladder = EscalationLadder(DEFAULT_LAYOUT, registry=registry,
+                                  variants=KernelVariantCache(registry))
+        ladder.escalate(gather_subcorpus(events, flagged))
+        cold = registry.counter(m.SCOPE_TPU_FALLBACK, m.M_LADDER_COMPILES)
+        assert cold >= 1
+        # same shapes (pow2-bucketed) → pure cache hits, zero compiles
+        ladder.escalate(gather_subcorpus(events, flagged))
+        ladder.escalate(gather_subcorpus(events, flagged[:-1]))
+        assert registry.counter(m.SCOPE_TPU_FALLBACK,
+                                m.M_LADDER_COMPILES) == cold
+        assert registry.counter(m.SCOPE_TPU_FALLBACK,
+                                m.M_LADDER_CACHE_HITS) >= 2
+
+    def test_wirec_ladder_crc_parity(self):
+        from cadence_tpu.ops.wirec import gather_corpus, pack_wirec
+
+        hists, events, _, errors = _overflow_setup(n=128)
+        flagged = np.nonzero(errors)[0]
+        corpus = pack_wirec(events)
+        # gather keeps the profile and the rows' exact bytes
+        sub = gather_corpus(corpus, flagged)
+        assert sub.profile == corpus.profile
+        assert (sub.n_events[:len(flagged)]
+                == corpus.n_events[flagged]).all()
+        ladder = EscalationLadder(DEFAULT_LAYOUT)
+        crcs, resolved, _ = ladder.escalate_wirec(corpus, flagged)
+        assert resolved.all()
+        for k, i in enumerate(flagged):
+            assert crcs[k] == np.uint32(crc32_of_row(_oracle_row(hists[i])))
+
+
+def _stores_with(hists):
+    stores = Stores()
+    keys = []
+    for h in hists:
+        key = (h[0].domain_id, h[0].workflow_id, h[0].run_id)
+        for b in h:
+            stores.history.append_batch(*key, list(b.events))
+        stores.execution.upsert_workflow(StateBuilder().replay_history(h))
+        keys.append(key)
+    return stores, keys
+
+
+class TestEngineEscalation:
+    def test_verify_all_escalates_under_pipelined_executor(self):
+        """Overflow corpus through the chunked, depth-≥2 pipelined
+        executor: capacity-flagged rows across MULTIPLE chunks resolve on
+        device (escalated, not oracle fallback), zero divergence."""
+        hists = generate_corpus("overflow", num_workflows=192, seed=SEED,
+                                target_events=60)
+        stores, keys = _stores_with(hists)
+        engine = TPUReplayEngine(stores, chunk_workflows=48,
+                                 pipeline_depth=2)
+        result = engine.verify_all(keys)
+        assert result.ok
+        assert result.verified_on_device == result.total == len(keys)
+        assert len(result.escalated) >= 2
+        assert result.fallback == []  # the oracle never ran
+        assert len(engine.last_run_chunk_shapes) == 4
+        # ladder accounting reached the engine's registry
+        reg = engine.metrics
+        assert reg.counter(m.SCOPE_TPU_FALLBACK, m.M_LADDER_RESOLVED) \
+            == len(result.escalated)
+
+    def test_verify_all_residual_still_arbitrates_through_oracle(self):
+        """A workflow overflowing EVERY rung verifies byte-identically
+        through the oracle path — the ladder narrows the oracle's job,
+        never changes its answer."""
+        hint = DEFAULT_LAYOUT.max_activities * 4
+        hists = generate_corpus("overflow", num_workflows=31, seed=SEED,
+                                target_events=60) + [_flood_history(hint)]
+        stores, keys = _stores_with(hists)
+        engine = TPUReplayEngine(stores, chunk_workflows=16,
+                                 pipeline_depth=2)
+        result = engine.verify_all(keys)
+        assert result.ok
+        assert keys[-1] in result.fallback
+        assert keys[-1] not in result.escalated
+        assert result.verified_on_device == result.total - 1
+
+    def test_verify_all_detects_divergence_in_escalated_rows(self):
+        """An escalated row whose LIVE state diverges must still be
+        caught — escalation is not a verification bypass."""
+        hists = generate_corpus("overflow", num_workflows=64, seed=SEED,
+                                target_events=60)
+        stores, keys = _stores_with(hists)
+        errors = np.asarray(replay_events(
+            jnp.asarray(encode_corpus(hists))).error)
+        bad = int(np.nonzero(errors)[0][0])
+        live = stores.execution.get_workflow(*keys[bad])
+        live.execution_info.signal_count += 7  # corrupt the live state
+        stores.execution.upsert_workflow(live, set_current=False)
+        result = TPUReplayEngine(stores, chunk_workflows=32,
+                                 pipeline_depth=2).verify_all(keys)
+        assert keys[bad] in result.divergent
+        assert not result.ok
+
+    def test_rebuild_hydrates_from_widened_rung_state(self):
+        from cadence_tpu.engine.rebuild import DeviceRebuilder
+
+        hists = generate_corpus("overflow", num_workflows=96, seed=SEED,
+                                target_events=60)
+        flagged = np.asarray(replay_events(
+            jnp.asarray(encode_corpus(hists))).error)
+        n_flagged = int((flagged != 0).sum())
+        assert n_flagged >= 1
+        rb = DeviceRebuilder(chunk_jobs=32)
+        states = rb.rebuild([(h, None) for h in hists])
+        assert rb.stats.ladder == n_flagged
+        assert rb.stats.oracle_fallback == 0
+        assert rb.stats.device == len(hists)
+        for ms, h in zip(states, hists):
+            got = payload_row(ms)
+            got[STICKY_ROW_INDEX] = 0
+            assert (got == _oracle_row(h)).all()

@@ -1,6 +1,6 @@
 """Perf gate (deploy/smoke_perf.sh, marker `perf`).
 
-Two layers:
+Three layers:
 
 1. Always-on zero-divergence checks: the pipelined bulk executor's
    chunked, overlapped transfer path must produce exactly the CRCs of a
@@ -8,12 +8,20 @@ Two layers:
    byte-identical wire bytes — a perf path that changes results is not a
    perf path.
 
-2. Baseline regression gate: when PERF_CURRENT / PERF_BASELINE point at
+2. Fallback-under-pressure gate: a forced ≥2.5%-flagged corpus runs the
+   capacity-escalation ladder (engine/ladder.py) end to end — the ladder
+   result must be CRC-identical to the oracle-only arbitration path and
+   warm trials must recompile nothing. With PERF_CURRENT / PERF_BASELINE
+   set, the recorded `fallback_under_pressure.mixed_rate_median` must
+   also stay within tolerance of the baseline: a reintroduced overflow
+   cliff (BENCH_r05's 3x collapse) fails CI here.
+
+3. Baseline regression gate: when PERF_CURRENT / PERF_BASELINE point at
    bench JSON files (the smoke script runs the small bench and wires the
    output next to the BENCH_r*.json trajectory), every common suite's
    `transfer_included_rate` must stay within PERF_TOLERANCE (default
    0.5x) of the recorded baseline, and `crc_parity_wire32` must hold.
-   Without the env vars the gate skips — rate asserts on shared CI boxes
+   Without the env vars the gates skip — rate asserts on shared CI boxes
    are noise, the smoke script is the place that pins hardware.
 """
 import json
@@ -26,6 +34,14 @@ from cadence_tpu.gen.corpus import generate_corpus
 from cadence_tpu.ops.encode import encode_corpus
 
 pytestmark = pytest.mark.perf
+
+
+def _load_bench(env: str):
+    path = os.environ.get(env, "")
+    if not path or not os.path.exists(path):
+        pytest.skip(f"{env} not set (run via deploy/smoke_perf.sh)")
+    with open(path) as f:
+        return json.load(f)
 
 
 class TestPipelinedParity:
@@ -74,13 +90,55 @@ class TestPipelinedParity:
                     np.asarray(crc_1).astype(np.uint32))))
 
 
+class TestFallbackGate:
+    def test_forced_fallback_ladder_parity_and_warm_compiles(self):
+        """The fallback suite at CI scale: ≥2.5% of workflows forced past
+        the device tables, the escalation ladder resolving ALL of them on
+        device, CRC-identical to the oracle-only arbitration, and warm
+        trials paying zero ladder recompiles."""
+        import bench
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+
+        res = bench._fallback_suite(512, DEFAULT_LAYOUT)
+        assert res["oracle_fallback_rate"] >= 0.025
+        assert res["fallback_workflows"] >= 4
+        assert res["crc_parity_oracle_only"], \
+            "ladder arbitration diverged from oracle-only arbitration"
+        assert res["crc_xor"] == res["crc_xor_oracle_only"]
+        assert res["residual_oracle_rows"] == 0
+        assert res["ladder_recompiles_warm"] == 0, \
+            "warm fallback trials recompiled a ladder rung"
+        assert sum(r["rows"] for r in res["ladder_rungs"]) \
+            >= res["fallback_workflows"]
+
+    def test_fallback_mixed_rate_vs_baseline(self):
+        """The cliff gate: the recorded fallback mixed rate must stay
+        within tolerance of the baseline's — BENCH_r05's 3x collapse
+        (1.22M vs 3.9M device-only) fails here once a ladder-era
+        baseline is recorded."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get(
+            "fallback_under_pressure")
+        base = _load_bench("PERF_BASELINE")["detail"].get(
+            "fallback_under_pressure")
+        assert cur, "current bench carries no fallback_under_pressure"
+        tol = float(os.environ.get("PERF_TOLERANCE", "0.5"))
+        assert cur["oracle_fallback_rate"] >= 0.02, \
+            "fallback suite stopped forcing pressure"
+        if "crc_parity_oracle_only" in cur:
+            assert cur["crc_parity_oracle_only"]
+            assert cur["ladder_recompiles_warm"] == 0
+        if base:
+            floor = tol * base["mixed_rate_median"]
+            assert cur["mixed_rate_median"] >= floor, (
+                f"fallback mixed_rate_median {cur['mixed_rate_median']} "
+                f"regressed below {tol:.0%} of baseline "
+                f"{base['mixed_rate_median']} — the overflow cliff is "
+                f"back")
+
+
 class TestBaselineGate:
     def _load(self, env):
-        path = os.environ.get(env, "")
-        if not path or not os.path.exists(path):
-            pytest.skip(f"{env} not set (run via deploy/smoke_perf.sh)")
-        with open(path) as f:
-            return json.load(f)
+        return _load_bench(env)
 
     def test_transfer_rate_within_tolerance_of_baseline(self):
         current = self._load("PERF_CURRENT")
