@@ -176,6 +176,11 @@ def main(argv=None) -> int:
                          "or Perfetto)")
     pr.add_argument("--workflows", type=int, default=256)
     pr.add_argument("--events", type=int, default=100)
+    res = adm.add_parser("resident")
+    res.add_argument("--passes", type=int, default=2,
+                     help="verify passes to run first (pass 1 seeds the "
+                          "cache, pass 2 measures the warm hit rate; "
+                          "0 = dump current stats only)")
 
     # WAL tools (adminDBScan/adminDBClean analogs over the one backend)
     wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
@@ -443,6 +448,18 @@ def main(argv=None) -> int:
                    "events_per_sec": round(real / wall),
                    "platform": jax.devices()[0].platform,
                    "legs": ReplayProfiler().summary()})
+        elif args.cmd == "resident":
+            # mirror of `admin profile` for the resident-state cache:
+            # optional verify passes drive the cache (cold seed, then
+            # warm hits), then the occupancy/hit-rate/budget rollup
+            passes = []
+            for _ in range(args.passes):
+                r = admin.verify()
+                passes.append({"total": r.total,
+                               "verified_on_device": r.verified_on_device,
+                               "resident_served": len(r.resident),
+                               "ok": r.ok})
+            _emit({"passes": passes, **admin.resident()})
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of

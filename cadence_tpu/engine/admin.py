@@ -176,3 +176,20 @@ class AdminHandler:
         operators like the CLI admin db scan)."""
         self._authorize("verify")
         return self.box.tpu.verify_all(keys)
+
+    def resident(self) -> Dict[str, Any]:
+        """Resident-state cache introspection (`admin resident` CLI
+        verb): occupancy, hit rates, and HBM budget of the cluster's
+        HBM-resident mutable-state cache (engine/resident.py) — the
+        operator's view of how much of the fleet's verify/rebuild
+        traffic is served incrementally."""
+        self._authorize("resident")
+        cache = self.box.tpu.resident
+        from .resident import enabled
+        return {
+            "enabled": enabled(),
+            **cache.stats(),
+            "chunk_workflows": cache.chunk_workflows,
+            "ladder_max_rungs": (cache.ladder.max_rungs
+                                 if cache.ladder is not None else 0),
+        }

@@ -30,9 +30,65 @@ from __future__ import annotations
 import copy
 import threading
 from collections import OrderedDict
-from typing import Any, Hashable, Optional, Tuple
+from typing import Any, Hashable, NamedTuple, Optional, Tuple
 
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Content addressing — ONE implementation shared by every cache keyed on
+# history content (PackCache here, engine/resident.ResidentStateCache):
+# the invalidation semantics (what counts as exact / prefix / stale) must
+# never drift between the host-side pack cache and the HBM-resident state
+# cache, or an append could replay against a state built from different
+# bytes than the lanes it packs.
+# ---------------------------------------------------------------------------
+
+
+def batch_crc(batch) -> int:
+    """CRC32 of one serialized batch — the tail fingerprint of the
+    content address (a torn/overwritten tail changes the last batch's
+    bytes, so the checksum catches every mutation the engine can
+    produce; new_run_events ride the serialized form too)."""
+    import zlib
+
+    from ..core.codec import serialize_history
+    return zlib.crc32(serialize_history([batch]))
+
+
+class ContentAddress(NamedTuple):
+    """(batch count, last-batch CRC32) — with the workflow key, the full
+    content address of one run's single-lineage history."""
+
+    batch_count: int
+    last_batch_crc: int
+
+
+def content_address(batches) -> ContentAddress:
+    """Address of a history as currently stored (empty histories address
+    as (0, 0) and never hit)."""
+    if not batches:
+        return ContentAddress(0, 0)
+    return ContentAddress(len(batches), batch_crc(batches[-1]))
+
+
+def address_relation(cached: ContentAddress, batches) -> str:
+    """How `batches` relates to a cached address:
+
+    - "exact":  same count and the last batch checksums the same;
+    - "prefix": MORE batches now and the batch at the cached count - 1
+      still checksums the same — the cached entry is a valid prefix,
+      only the appended suffix is new (histories are append-only);
+    - "stale":  anything else — fewer batches, or a checksum mismatch at
+      the cached position (tail overwrite after a retried transaction,
+      reset rewrite). The caller must invalidate, never serve.
+    """
+    n = len(batches)
+    if cached.batch_count <= 0 or cached.batch_count > n:
+        return "stale"
+    if batch_crc(batches[cached.batch_count - 1]) != cached.last_batch_crc:
+        return "stale"
+    return "exact" if cached.batch_count == n else "prefix"
 
 
 class LRUCache:
@@ -148,12 +204,6 @@ class PackCache:
         self.metrics = registry if registry is not None else m.DEFAULT_REGISTRY
         self._m = m
 
-    @staticmethod
-    def _batch_crc(batch) -> int:
-        import zlib
-        from ..core.codec import serialize_history
-        return zlib.crc32(serialize_history([batch]))
-
     def encode(self, key: Tuple[str, str, str], batches) -> np.ndarray:
         """Encoded [n, L] rows for this key's history (single lineage,
         batches in store order). Callers must treat the result as
@@ -167,29 +217,41 @@ class PackCache:
             return np.zeros((0, NUM_LANES), dtype=np.int64)
         entry = self.lru.get(key)
         if entry is not None:
-            rows, cached_n, cached_crc, interner_map = entry
-            if cached_n <= n_batches and \
-                    self._batch_crc(batches[cached_n - 1]) == cached_crc:
-                if cached_n == n_batches:
-                    scope.inc(m.M_CACHE_HITS)
-                    return rows
+            rows, address, interner_map = entry
+            relation = address_relation(address, batches)
+            if relation == "exact":
+                scope.inc(m.M_CACHE_HITS)
+                return rows
+            if relation == "prefix":
                 # valid prefix: pack only the appended suffix
                 suffix, new_map = encode_batches_resumable(
-                    batches[cached_n:], interner_map)
+                    batches[address.batch_count:], interner_map)
                 rows = np.concatenate([rows, suffix])
                 scope.inc(m.M_CACHE_SUFFIX_PACKS)
-                self._put(key, rows, n_batches,
-                          self._batch_crc(batches[-1]), new_map)
+                self._put(key, rows, content_address(batches), new_map)
                 return rows
         scope.inc(m.M_CACHE_MISSES)
         rows, interner_map = encode_batches_resumable(batches)
-        self._put(key, rows, n_batches, self._batch_crc(batches[-1]),
-                  interner_map)
+        self._put(key, rows, content_address(batches), interner_map)
         return rows
 
-    def _put(self, key, rows, n_batches, last_crc, interner_map) -> None:
-        evicted = self.lru.put(key, (rows, n_batches, last_crc,
-                                     interner_map))
+    def encode_suffix(self, key: Tuple[str, str, str], batches,
+                      from_batch: int) -> np.ndarray:
+        """Only the rows of batches[from_batch:] — the resident-state
+        append path (engine/resident.py): the device replays JUST the
+        appended lanes against the HBM-resident state. Encoding goes
+        through encode() so the suffix bytes are guaranteed identical to
+        the corresponding slice of a full pack (resumed-interner
+        contract) and the pack-cache counters keep telling the truth
+        about how the lanes were produced (hit / suffix-pack / miss)."""
+        from ..ops.encode import history_length
+
+        rows = self.encode(key, batches)
+        return rows[history_length(batches[:from_batch]):]
+
+    def _put(self, key, rows, address: ContentAddress,
+             interner_map) -> None:
+        evicted = self.lru.put(key, (rows, address, interner_map))
         if evicted:
             self.metrics.inc(self._m.SCOPE_PACK_CACHE,
                              self._m.M_CACHE_EVICTIONS, evicted)

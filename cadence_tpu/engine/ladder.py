@@ -294,6 +294,87 @@ class EscalationLadder:
                               errors=err_out, branch=branch_out,
                               rungs=list(self.last_run)), states)
 
+    # -- resident (from-state) path (engine/resident.py appends) ------------
+
+    def escalate_resident(self, sub: np.ndarray, states, base_rung: int = 0):
+        """Widened re-replay of an APPEND suffix against carried states.
+
+        `sub` is the trimmed [F, E, L] suffix sub-corpus of rows whose
+        from-state append flagged a CAPACITY error; `states` the batched
+        PRE-APPEND resident states those rows replayed from (all at rung
+        `base_rung`'s layout). Each rung widens the pre-append state
+        (ops/state.widen_state — occupied slots keep their indices, new
+        slots are empty) and re-replays ONLY the suffix, so an escalated
+        append stays O(new events): the full history never re-replays and
+        the row never leaves HBM.
+
+        Returns (outcome, states_out): outcome rows/resolved/errors/branch
+        aligned with `sub`; states_out[k] = (batched final state, local
+        row, rung) of the rung that resolved row k, or None — the caller
+        re-admits resolved rows as widened resident states (and may
+        re-narrow them via ops/state.narrow_ok once their load drains).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.state import init_state, widen_state
+
+        F = sub.shape[0]
+        self.metrics.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_FLAGGED, F)
+        self.last_run = []
+        rows_out = np.zeros((F, self.layout.width), np.int64)
+        resolved = np.zeros(F, bool)
+        err_out = np.zeros(F, np.int32)
+        branch_out = np.zeros(F, np.int32)
+        states_out: List[Optional[tuple]] = [None] * F
+        active = np.arange(F)
+        cur = sub
+        cur_states = states
+        for rung in range(base_rung + 1, self.max_rungs + 1):
+            t0 = time.perf_counter()
+            layout_r = self.rung_layout(rung)
+            padded = self._pad_dense(cur)
+            Wp, Ep = padded.shape[:2]
+            s0 = widen_state(cur_states, layout_r)
+            if Wp > len(active):
+                pad_rows = init_state(Wp - len(active), layout_r)
+                s0 = jax.tree_util.tree_map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0),
+                    s0, pad_rows)
+            key = ("resident", self.layout, rung, Wp, Ep)
+
+            def build():
+                from ..ops.replay import replay_from_state_to_payload
+                return lambda ev, st: replay_from_state_to_payload(
+                    jnp.asarray(ev), st, self.layout)
+
+            fn = self.variants.get(key, build, self.metrics)
+            s_fin, rows_dev, err_dev, ovf_dev = fn(padded, s0)
+            rows = np.asarray(rows_dev)[:len(active)]
+            err = np.asarray(err_dev)[:len(active)]
+            ovf = np.asarray(ovf_dev)[:len(active)]
+            branch = np.asarray(s_fin.current_branch)[:len(active)]
+            self._record_rung(rung, len(active), time.perf_counter() - t0)
+            ok = (err == 0) & ~ovf
+            for k in np.nonzero(ok)[0]:
+                gi = active[k]
+                rows_out[gi] = rows[k]
+                resolved[gi] = True
+                branch_out[gi] = branch[k]
+                states_out[gi] = (s_fin, int(k), rung)
+            err_out[active] = err
+            still = self.capacity_flagged(err)
+            if not len(still):
+                break
+            cur = gather_subcorpus(cur, still)
+            cur_states = jax.tree_util.tree_map(
+                lambda a: a[np.asarray(still)], cur_states)
+            active = active[still]
+        self._finalize(resolved)
+        return (LadderOutcome(rows=rows_out, resolved=resolved,
+                              errors=err_out, branch=branch_out,
+                              rungs=list(self.last_run)), states_out)
+
     # -- wirec path (bench / CRC consumers) ---------------------------------
 
     def escalate_wirec(self, corpus, indices) -> Tuple[np.ndarray,

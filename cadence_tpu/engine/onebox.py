@@ -83,6 +83,12 @@ class Onebox:
         from .rebuild import DeviceRebuilder
         self.rebuilder = DeviceRebuilder(layout)
         self.rebuilder.metrics = self.metrics
+        # the rebuilder consults the SAME resident-state cache verify_all
+        # seeds: a rebuild of a cached workflow replays only its appended
+        # batches (engine/resident.py), packed through the engine's pack
+        # cache so the host side is O(suffix) too
+        self.rebuilder.resident = self.tpu.resident
+        self.rebuilder.pack_cache = self.tpu.pack_cache
         # one consistent-query registry for the cluster (shard movement
         # within the box keeps waiters reachable)
         from .query import QueryRegistry

@@ -55,12 +55,17 @@ class RebuildStats:
     #: subset of `device` that resolved through the widened-K escalation
     #: ladder (capacity-flagged histories that stayed on device)
     ladder: int = 0
+    #: subset of `device` served by the HBM-resident state cache: an
+    #: exact hit hydrates straight from the pinned state (zero replay),
+    #: a suffix hit replays only the appended batches
+    resident: int = 0
     kernel_errors: Dict[int, int] = field(default_factory=dict)
 
     def merge(self, other: "RebuildStats") -> None:
         self.device += other.device
         self.oracle_fallback += other.oracle_fallback
         self.ladder += other.ladder
+        self.resident += other.resident
         for code, n in other.kernel_errors.items():
             self.kernel_errors[code] = self.kernel_errors.get(code, 0) + n
 
@@ -92,6 +97,14 @@ class DeviceRebuilder:
         self.stats = RebuildStats()
         self.metrics = DEFAULT_REGISTRY
         self.ladder = EscalationLadder(layout, registry=self.metrics)
+        #: HBM-resident state cache to consult before full replay
+        #: (Onebox wires the cluster's shared cache here — the same one
+        #: TPUReplayEngine.verify_all seeds); None skips the consult
+        self.resident = None
+        #: pack cache whose suffix path encodes resident appends O(suffix)
+        #: (Onebox wires the engine's; without one, appends fall back to
+        #: a full re-encode sliced at the prefix — correct, O(history))
+        self.pack_cache = None
         #: max jobs per device launch (bounds the [W, E, L] corpus the
         #: same way the replay engine's chunking does)
         self.chunk_jobs = (chunk_jobs if chunk_jobs else
@@ -130,6 +143,19 @@ class DeviceRebuilder:
 
         if not jobs:
             return []
+        # resident consult: jobs whose key is pinned in the HBM cache
+        # rebuild from the resident state — an exact hit hydrates with
+        # ZERO replay, a suffix hit replays only the appended batches
+        # (lookups are non-authoritative: rebuild may legitimately pass
+        # a prefix of the stored history, e.g. a reset point)
+        pre: Dict[int, MutableState] = self._resident_prepass(jobs)
+        if pre:
+            positions = [i for i in range(len(jobs)) if i not in pre]
+            jobs = [jobs[i] for i in positions]
+            if not jobs:
+                return [pre[i] for i in sorted(pre)]
+        else:
+            positions = list(range(len(jobs)))
         from ..utils import metrics as m
         from ..utils.profiler import ReplayProfiler
         from .executor import BulkReplayExecutor
@@ -186,7 +212,9 @@ class DeviceRebuilder:
             except RuntimeError:
                 self.stats.oracle_fallback += len(jobs)
                 scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
-                return [self._oracle_rebuild(b, e) for b, e in jobs]
+                return self._merge_prepass(
+                    pre, positions,
+                    [self._oracle_rebuild(b, e) for b, e in jobs])
             raise
 
         from ..ops.state import CAPACITY_ERRORS
@@ -249,7 +277,83 @@ class DeviceRebuilder:
         done = self.stats.device + self.stats.oracle_fallback
         self.metrics.gauge(m.SCOPE_REBUILD, m.M_FALLBACK_RATE,
                            (self.stats.oracle_fallback / done) if done else 0.0)
-        return out
+        return self._merge_prepass(pre, positions, out)
+
+    @staticmethod
+    def _merge_prepass(pre: Dict[int, MutableState], positions: List[int],
+                       device_out: List[MutableState]) -> List[MutableState]:
+        if not pre:
+            return device_out
+        merged = dict(pre)
+        merged.update(zip(positions, device_out))
+        return [merged[i] for i in range(len(merged))]
+
+    def _resident_prepass(self, jobs) -> Dict[int, MutableState]:
+        """Resolve jobs out of the resident state cache: returns
+        {job position: hydrated MutableState} for every job it could
+        serve. Every resident-hydrated state is checked elementwise
+        against the cache's canonical payload row — same contract as the
+        full-replay hydration check below; a mismatch simply leaves the
+        job to the device path, counted nowhere special (it will be
+        measured there)."""
+        from . import resident as resident_mod
+
+        cache = self.resident
+        if cache is None or not resident_mod.enabled():
+            return {}
+        from ..utils import metrics as m
+        pre: Dict[int, MutableState] = {}
+        suffix_items = []
+        suffix_jobs = []
+        for pos, (batches, entry) in enumerate(jobs):
+            if not batches:
+                continue
+            b0 = batches[0]
+            key = (b0.domain_id, b0.workflow_id, b0.run_id)
+            hit = cache.lookup(key, batches, authoritative=False)
+            if hit is None:
+                continue
+            kind, rentry = hit
+            if kind == "exact":
+                ms = self._hydrate_resident(rentry, batches, entry)
+                if ms is not None:
+                    pre[pos] = ms
+            else:
+                suffix_items.append((key, rentry, batches))
+                suffix_jobs.append((pos, batches, entry))
+        if suffix_items:
+            outcomes = cache.replay_append(
+                suffix_items,
+                encode_suffix=(self.pack_cache.encode_suffix
+                               if self.pack_cache is not None else None))
+            for (pos, batches, entry), (key, _r, _b), res in zip(
+                    suffix_jobs, suffix_items, outcomes):
+                if not res.ok:
+                    continue  # entry invalidated; device path takes it
+                hit2 = cache.lookup(key, batches, authoritative=False)
+                if hit2 is not None and hit2[0] == "exact":
+                    ms = self._hydrate_resident(hit2[1], batches, entry)
+                    if ms is not None:
+                        pre[pos] = ms
+        if pre:
+            self.stats.device += len(pre)
+            self.stats.resident += len(pre)
+            scope = self.metrics.scope(m.SCOPE_REBUILD)
+            scope.inc(m.M_DEVICE_REBUILDS, len(pre))
+        return pre
+
+    def _hydrate_resident(self, rentry, batches,
+                          entry) -> Optional[MutableState]:
+        """Hydrate a MutableState from a pinned (possibly ladder-widened)
+        state row; verified against the cache's canonical payload."""
+        import jax
+
+        arrs = jax.device_get(rentry.state)
+        ms = self._hydrate(arrs, 0, batches, entry)
+        if ms is None or not (payload_row(ms, self.layout)
+                              == rentry.payload).all():
+            return None
+        return ms
 
     @staticmethod
     def _oracle_rebuild(batches, entry) -> MutableState:

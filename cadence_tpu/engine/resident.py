@@ -1,0 +1,548 @@
+"""HBM-resident mutable-state cache: O(new-events) append replay.
+
+The reference never replays a live workflow from event 0 on the hot
+path: the history engine's execution/context LRU cache
+(service/history/execution/cache.go) keeps each open workflow's mutable
+state warm, and a decision transaction applies only its new events.
+Before this module the device path had no analogue — every verify or
+rebuild replayed the FULL history, so per-transaction cost was
+O(history) and long-lived workflows set the p99 floor for decision hot
+loops.
+
+ResidentStateCache is the device twin of that execution cache:
+
+- per-workflow final `ReplayState` rows stay RESIDENT in HBM between
+  calls (W=1 slices of the batched scan state, one pytree of device
+  arrays per workflow), LRU-bounded by a configurable HBM byte budget;
+- entries are content-addressed by the same (workflow key, batch count,
+  last-batch CRC32) scheme the pack cache uses — the shared helper in
+  engine/cache.py, so the two caches can never drift on invalidation
+  semantics. A tail overwrite, reset rewrite, or NDC branch switch
+  changes the address (or the lineage shape) and the stale entry is
+  dropped, counted, never served;
+- an append replays ONLY the new batches: suffix lanes (packed through
+  the pack cache's suffix path) scan against the resident state via
+  ops/replay.replay_from_state — the kernel generalized to take a
+  carried initial state instead of the zero state;
+- capacity overflow during an append stays on device: the escalation
+  ladder widens the PRE-append resident state (K→2K→4K) and re-replays
+  just the suffix (engine/ladder.escalate_resident); resolved rows
+  remain resident at the widened layout and re-narrow to base once
+  their pending load drains (ops/state.narrow_ok) — the widen/re-narrow
+  round trip that keeps escalated rows out of the full-replay path.
+
+Correctness gate: the mutable-state checksum is the oracle, same as
+always — resident incremental replay must produce byte-identical
+canonical payloads (and CRCs) to a full-history replay, for every
+workload suite, after every invalidation path. Appends are batched
+through the pipelined bulk executor (engine/executor.py), so suffix
+packing overlaps device replay exactly like the cold path's chunks.
+
+Counters land under `tpu.resident/*` (hits, suffix-hits, misses,
+invalidations, evictions, events-appended, widened/renarrowed rows) and
+the resident-bytes/entries/budget gauges — pre-registered on /metrics
+by ServiceHost so scrapes always expose the names.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
+from ..ops.encode import NUM_LANES, history_length
+from ..utils import metrics as m
+from .cache import ContentAddress, address_relation, content_address
+
+#: HBM byte budget for resident states (LRU evicts past it); the default
+#: holds ~4k base-layout rows — sized for the serving tier, overridable
+#: per deployment
+BUDGET_ENV = "CADENCE_TPU_RESIDENT_HBM_BUDGET"
+DEFAULT_BUDGET = 256 << 20
+#: workflows per append-replay chunk through the bulk executor
+CHUNK_ENV = "CADENCE_TPU_RESIDENT_CHUNK"
+DEFAULT_CHUNK = 2048
+#: kill switch (CADENCE_TPU_RESIDENT=0 forces every call down the
+#: full-replay path; the parity-audit configuration)
+ENABLE_ENV = "CADENCE_TPU_RESIDENT"
+
+#: live caches (tests reset them between cases: entries hold device
+#: buffers that must not leak across test boundaries)
+_LIVE: "weakref.WeakSet[ResidentStateCache]" = weakref.WeakSet()
+
+
+def reset_all() -> None:
+    """Clear every live cache's entries (conftest isolation seam)."""
+    for cache in list(_LIVE):
+        cache.clear()
+
+
+def enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "1") not in ("0", "false", "off")
+
+
+def _bucket(n: int, floor: int) -> int:
+    return max(floor, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+@dataclass
+class ResidentEntry:
+    """One workflow's pinned state + the host-side row that serves exact
+    hits without touching the device."""
+
+    state: object            # ReplayState, W=1 device arrays
+    payload: np.ndarray      # [base_width] canonical payload row
+    branch: int              # device-chosen current branch
+    address: ContentAddress
+    rung: int                # 0 = base layout; r > 0 = widened 2**r
+    nbytes: int
+
+
+@dataclass
+class AppendResult:
+    """Outcome of one append transaction (aligned with replay_append's
+    items): resolved rows carry the post-append canonical payload;
+    unresolved ones name the kernel error and fall to the caller's
+    oracle arbitration (their entry is already invalidated)."""
+
+    ok: bool
+    payload: Optional[np.ndarray] = None
+    branch: int = 0
+    error: int = 0
+    rung: int = 0
+    escalated: bool = False
+
+
+@dataclass
+class AppendReport:
+    """Per-call accounting (bench's incremental suite reads this)."""
+
+    transactions: int = 0
+    events_appended: int = 0
+    escalated_rows: int = 0
+    #: (workflows, suffix event axis) per launched chunk — the
+    #: O(new-events) seam: equal suffixes launch equal shapes no matter
+    #: how long the underlying histories are
+    chunk_shapes: List[Tuple[int, int]] = field(default_factory=list)
+
+
+class ResidentStateCache:
+    """Content-addressed LRU of HBM-resident per-workflow ReplayStates."""
+
+    def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT,
+                 budget_bytes: Optional[int] = None,
+                 registry=None, ladder=None,
+                 chunk_workflows: Optional[int] = None,
+                 pipeline_depth: Optional[int] = None) -> None:
+        self.layout = layout
+        self.budget_bytes = (budget_bytes if budget_bytes is not None
+                             else int(os.environ.get(BUDGET_ENV,
+                                                     str(DEFAULT_BUDGET))))
+        self.metrics = registry if registry is not None else m.DEFAULT_REGISTRY
+        #: widened-K escalation for appends that overflow the resident
+        #: layout (engine/ladder.py); None disables escalation (flagged
+        #: appends fail to the caller's oracle path)
+        self.ladder = ladder
+        self.chunk_workflows = (chunk_workflows if chunk_workflows
+                                else int(os.environ.get(CHUNK_ENV,
+                                                        str(DEFAULT_CHUNK))))
+        self.pipeline_depth = pipeline_depth
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, ResidentEntry]" = OrderedDict()
+        self._bytes = 0
+        self._row_bytes_cache: Dict[PayloadLayout, int] = {}
+        self.last_append = AppendReport()
+        _LIVE.add(self)
+        self._gauges()
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _scope(self):
+        return self.metrics.scope(m.SCOPE_TPU_RESIDENT)
+
+    def _gauges(self) -> None:
+        self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_BYTES,
+                           float(self._bytes))
+        self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_ENTRIES,
+                           float(len(self._entries)))
+        self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_BUDGET_BYTES,
+                           float(self.budget_bytes))
+
+    def _row_nbytes(self, layout: PayloadLayout) -> int:
+        """HBM bytes of one W=1 state row at `layout` (+ the host payload
+        row); computed once per layout from the leaf dtypes/shapes."""
+        cached = self._row_bytes_cache.get(layout)
+        if cached is None:
+            import jax
+
+            from ..ops.state import init_state
+            row = init_state(1, layout)
+            cached = int(sum(leaf.nbytes
+                             for leaf in jax.tree_util.tree_leaves(row)))
+            cached += self.layout.width * 8
+            self._row_bytes_cache[layout] = cached
+        return cached
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, object]:
+        """Occupancy / hit-rate / budget rollup (the `admin resident`
+        CLI verb and scrape consumers)."""
+        reg = self.metrics
+        hits = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_HITS)
+        suffix = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_SUFFIX_HITS)
+        misses = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_MISSES)
+        looked = hits + suffix + misses
+        with self._lock:
+            entries = len(self._entries)
+            resident = self._bytes
+            widened = sum(1 for e in self._entries.values() if e.rung > 0)
+        return {
+            "entries": entries,
+            "widened_entries": widened,
+            "resident_bytes": resident,
+            "budget_bytes": self.budget_bytes,
+            "budget_used": (resident / self.budget_bytes
+                            if self.budget_bytes else 0.0),
+            "hits": hits,
+            "suffix_hits": suffix,
+            "misses": misses,
+            "hit_rate": ((hits + suffix) / looked) if looked else 0.0,
+            "invalidations": reg.counter(m.SCOPE_TPU_RESIDENT,
+                                         m.M_CACHE_INVALIDATIONS),
+            "evictions": reg.counter(m.SCOPE_TPU_RESIDENT,
+                                     m.M_CACHE_EVICTIONS),
+            "events_appended": reg.counter(m.SCOPE_TPU_RESIDENT,
+                                           m.M_RESIDENT_EVENTS_APPENDED),
+        }
+
+    # -- lookup / admit / invalidate ----------------------------------------
+
+    def lookup(self, key: tuple, batches,
+               authoritative: bool = True) -> Optional[Tuple[str,
+                                                             ResidentEntry]]:
+        """("exact"|"suffix", entry) or None (miss).
+
+        `batches` must be the key's CURRENT single-lineage history when
+        `authoritative` (verify/serving paths): a stale entry — tail
+        overwrite, reset rewrite — is then invalidated on sight. Pass
+        authoritative=False when batches may be a deliberate prefix of
+        the stored history (rebuild replaying up to a reset point): the
+        entry stays, the call just misses."""
+        scope = self._scope()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is not None:
+            relation = address_relation(entry.address, batches)
+            if relation == "exact":
+                scope.inc(m.M_CACHE_HITS)
+                return ("exact", entry)
+            if relation == "prefix":
+                scope.inc(m.M_RESIDENT_SUFFIX_HITS)
+                return ("suffix", entry)
+            if authoritative:
+                self.invalidate(key)
+        scope.inc(m.M_CACHE_MISSES)
+        return None
+
+    def invalidate(self, key: tuple) -> bool:
+        """Drop an entry (counted); the tail-overwrite / reset / NDC
+        branch-switch seam — callers that detect a non-append mutation
+        call this, and lookup() calls it itself on address mismatch."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+            self._gauges()
+        if entry is not None:
+            self._scope().inc(m.M_CACHE_INVALIDATIONS)
+        return entry is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._gauges()
+
+    def admit(self, key: tuple, address: ContentAddress, state_row,
+              payload: np.ndarray, branch: int, rung: int = 0) -> bool:
+        """Pin one workflow's W=1 state row; LRU-evicts past the HBM
+        budget. `state_row` must already be a W=1 slice (extract_row).
+        Returns False when the row alone exceeds the budget (never
+        admitted — a budget of 0 disables residency entirely)."""
+        from ..ops.state import layout_of
+
+        nbytes = self._row_nbytes(layout_of(state_row))
+        if nbytes > self.budget_bytes:
+            return False
+        entry = ResidentEntry(state=state_row,
+                              payload=np.asarray(payload, dtype=np.int64),
+                              branch=int(branch), address=address,
+                              rung=int(rung), nbytes=nbytes)
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += nbytes
+            while self._bytes > self.budget_bytes and len(self._entries) > 1:
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped.nbytes
+                evicted += 1
+            self._gauges()
+        if evicted:
+            self.metrics.inc(m.SCOPE_TPU_RESIDENT, m.M_CACHE_EVICTIONS,
+                             evicted)
+        return True
+
+    # -- device helpers -----------------------------------------------------
+
+    @staticmethod
+    def extract_row(state, index: int):
+        """W=1 device slice of row `index` from a batched ReplayState
+        (one dynamic-slice launch per leaf; jit-cached per shape)."""
+        return _slice_row(state, index)
+
+    @staticmethod
+    def _stack_rows(rows: Sequence[object]):
+        """Batch W=1 state rows back into one [k, ...] ReplayState."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *rows)
+
+    # -- the append transaction ---------------------------------------------
+
+    def replay_append(self, items: Sequence[Tuple[tuple, ResidentEntry,
+                                                  Sequence]],
+                      encode_suffix: Optional[Callable] = None
+                      ) -> List[AppendResult]:
+        """Replay ONLY the appended batches of each item against its
+        resident state; items are (key, entry, full current batches)
+        from suffix-hit lookups.
+
+        Chunked through the pipelined bulk executor: suffix packing of
+        chunk N+1 overlaps the device replay of chunk N (depth ≥ 2), the
+        same discipline as the cold path — but each chunk's corpus is
+        sized by its longest SUFFIX, not its longest history, which is
+        the whole point. Entries sharing a widened rung batch together
+        (states in one launch must share a layout).
+
+        On success the entry is re-addressed in place (state, payload,
+        branch, address); capacity overflow escalates through the ladder
+        from the PRE-append state and the row stays resident widened
+        (re-narrowing to base once narrow_ok holds); any other failure
+        invalidates the entry and returns ok=False for oracle
+        arbitration."""
+        if encode_suffix is None:
+            encode_suffix = _encode_suffix_cold
+        results: List[Optional[AppendResult]] = [None] * len(items)
+        self.last_append = AppendReport(transactions=len(items))
+        by_rung: Dict[int, List[int]] = {}
+        for i, (_key, entry, _batches) in enumerate(items):
+            by_rung.setdefault(entry.rung, []).append(i)
+        for rung, idxs in sorted(by_rung.items()):
+            self._append_group(items, idxs, rung, encode_suffix, results)
+        return [r if r is not None else AppendResult(ok=False)
+                for r in results]
+
+    def _append_group(self, items, idxs: List[int], rung: int,
+                      encode_suffix, results: List) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.encode import assemble_corpus
+        from ..ops.replay import replay_from_state_to_payload
+        from ..ops.state import init_state, layout_of
+        from .executor import BulkReplayExecutor
+
+        chunk = max(1, self.chunk_workflows)
+        spans = [(lo, min(lo + chunk, len(idxs)))
+                 for lo in range(0, len(idxs), chunk)]
+        executor = BulkReplayExecutor(depth=self.pipeline_depth,
+                                      registry=self.metrics,
+                                      scope=m.SCOPE_TPU_RESIDENT)
+        scope = self._scope()
+        layout_g = layout_of(items[idxs[0]][1].state)
+
+        def pack(ci):
+            lo, hi = spans[ci]
+            rows_list = []
+            for i in idxs[lo:hi]:
+                key, entry, batches = items[i]
+                rows_list.append(encode_suffix(
+                    key, batches, entry.address.batch_count))
+            E = _bucket(max((r.shape[0] for r in rows_list), default=1), 16)
+            Wp = _bucket(len(rows_list), 8)
+            corpus = assemble_corpus(rows_list, E)
+            if corpus.shape[0] < Wp:
+                pad = np.zeros((Wp - corpus.shape[0], E, NUM_LANES),
+                               dtype=np.int64)
+                pad[:, :, 1] = -1  # LANE_EVENT_TYPE: no-op padding rows
+                corpus = np.concatenate([corpus, pad])
+            return corpus
+
+        def launch(ci, corpus):
+            lo, hi = spans[ci]
+            states = [items[i][1].state for i in idxs[lo:hi]]
+            if corpus.shape[0] > len(states):
+                states.append(init_state(corpus.shape[0] - len(states),
+                                         layout_g))
+            s0 = self._stack_rows(states) if len(states) > 1 else states[0]
+            self.last_append.chunk_shapes.append(
+                (corpus.shape[0], corpus.shape[1]))
+            events = int((corpus[:, :, 0] > 0).sum())  # LANE_EVENT_ID
+            self.last_append.events_appended += events
+            scope.inc(m.M_RESIDENT_EVENTS_APPENDED, events)
+            corpus_dev = jax.device_put(jnp.asarray(corpus))
+            outs = replay_from_state_to_payload(corpus_dev, s0, self.layout)
+            return corpus, outs
+
+        def consume(ci, packed):
+            corpus, (s_fin, rows_dev, err_dev, ovf_dev) = packed
+            jax.block_until_ready(rows_dev)
+            return (corpus, s_fin, np.asarray(rows_dev),
+                    np.asarray(err_dev), np.asarray(ovf_dev),
+                    np.asarray(s_fin.current_branch))
+
+        chunk_outs, _report = executor.run(len(spans), pack, launch, consume)
+
+        from ..ops.state import CAPACITY_ERRORS
+        for (lo, hi), (corpus, s_fin, rows, err, ovf, branch) in zip(
+                spans, chunk_outs):
+            group = idxs[lo:hi]
+            flagged = [j for j in range(len(group))
+                       if err[j] in CAPACITY_ERRORS
+                       or (err[j] == 0 and ovf[j])]
+            narrow_mask = self._narrow_mask(s_fin, rung)
+            for j, i in enumerate(group):
+                if j in flagged:
+                    continue
+                key, entry, batches = items[i]
+                if err[j] != 0:
+                    # genuine history error no capacity fixes: drop the
+                    # entry, let the caller's oracle arbitrate
+                    self.invalidate(key)
+                    results[i] = AppendResult(ok=False, error=int(err[j]))
+                    continue
+                results[i] = self._readmit(
+                    key, batches, s_fin, j, rows[j], int(branch[j]), rung,
+                    bool(narrow_mask[j]) if narrow_mask is not None else False)
+            if flagged:
+                self._escalate(items, [group[j] for j in flagged],
+                               corpus[[j for j in flagged]], rung, results)
+
+    def _narrow_mask(self, s_fin, rung: int):
+        """[W] bool of rows that can re-narrow to base, None at base."""
+        if rung == 0:
+            return None
+        from ..ops.state import narrow_ok
+        return np.asarray(narrow_ok(s_fin, self.layout))
+
+    def _readmit(self, key, batches, s_fin, row: int, payload, branch: int,
+                 rung: int, narrowable: bool) -> AppendResult:
+        """Re-pin one successfully appended row (re-narrowed when its
+        load drained back under base capacities)."""
+        state_row = self.extract_row(s_fin, row)
+        if rung > 0 and narrowable:
+            from ..ops.state import narrow_state
+            state_row = narrow_state(state_row, self.layout)
+            rung = 0
+            self._scope().inc(m.M_RESIDENT_NARROWED)
+        self.admit(key, content_address(batches), state_row, payload,
+                   branch, rung)
+        return AppendResult(ok=True, payload=np.asarray(payload),
+                            branch=branch, rung=rung)
+
+    def _escalate(self, items, flat_idxs: List[int], sub: np.ndarray,
+                  rung: int, results: List) -> None:
+        """Widened re-replay of capacity-flagged appends from their
+        PRE-append resident states (the entries still hold them — they
+        only re-admit on success)."""
+        from ..ops.encode import gather_subcorpus
+
+        if self.ladder is None:
+            for i in flat_idxs:
+                self.invalidate(items[i][0])
+                results[i] = AppendResult(ok=False, error=-1)
+            return
+        scope = self._scope()
+        scope.inc(m.M_RESIDENT_WIDENED, len(flat_idxs))
+        self.last_append.escalated_rows += len(flat_idxs)
+        pre_states = self._stack_rows([items[i][1].state
+                                       for i in flat_idxs])
+        trimmed = gather_subcorpus(sub, np.arange(sub.shape[0]))
+        outcome, states_out = self.ladder.escalate_resident(
+            trimmed, pre_states, base_rung=rung)
+        #: (id of rung state, rung) -> narrow mask, computed ONCE per
+        #: distinct rung state (all rows resolved at a rung share it)
+        masks: Dict[tuple, object] = {}
+        for k, i in enumerate(flat_idxs):
+            key, entry, batches = items[i]
+            if not outcome.resolved[k]:
+                from ..ops.state import ErrorCode
+                # a zero ladder error here means the FINAL state exceeds
+                # the base canonical payload (narrow overflow) — report
+                # it as the overflow it is, never as "no error"
+                err = int(outcome.errors[k]) or ErrorCode.TABLE_OVERFLOW
+                self.invalidate(key)
+                results[i] = AppendResult(ok=False, error=err,
+                                          escalated=True)
+                continue
+            s_fin, local, got_rung = states_out[k]
+            mkey = (id(s_fin), got_rung)
+            if mkey not in masks:
+                masks[mkey] = self._narrow_mask(s_fin, got_rung)
+            narrow_mask = masks[mkey]
+            res = self._readmit(
+                key, batches, s_fin, local, outcome.rows[k],
+                int(outcome.branch[k]), got_rung,
+                bool(narrow_mask[local]) if narrow_mask is not None
+                else False)
+            res.escalated = True
+            results[i] = res
+
+
+def _encode_suffix_cold(key, batches, from_batch: int) -> np.ndarray:
+    """Pack-cache-free suffix encoder (standalone consumers: bench,
+    tests): a full resumable encode sliced at the prefix row count —
+    byte-identical to the pack cache's suffix path, just without the
+    O(suffix) warm cost."""
+    from ..ops.encode import encode_batches_resumable
+
+    rows, _ = encode_batches_resumable(batches)
+    return rows[history_length(batches[:from_batch]):]
+
+
+_SLICE_FN = None
+
+
+def _slice_row(state, index: int):
+    """Jitted per-leaf dynamic slice (index traced: one compile per
+    state shape, not per row index)."""
+    global _SLICE_FN
+    if _SLICE_FN is None:
+        import jax
+
+        def slice_row(s, i):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0), s)
+
+        _SLICE_FN = jax.jit(slice_row)
+    return _SLICE_FN(state, index)

@@ -62,10 +62,12 @@ from ..ops.payload import payload_rows
 from ..ops.replay import replay_events, verify_rows
 from ..utils import metrics as m
 from ..utils.profiler import ReplayProfiler
-from .cache import PackCache
+from . import resident as resident_mod
+from .cache import PackCache, content_address
 from .executor import BulkReplayExecutor
 from .ladder import EscalationLadder
 from .persistence import Stores
+from .resident import ResidentStateCache
 
 #: max workflows per device launch on the bulk path; bounds peak host
 #: corpus bytes and HBM per chunk (the regression the chunked executor
@@ -93,6 +95,9 @@ class BulkVerifyResult:
     device_errors: List[Tuple[Tuple[str, str, str], int]] = field(default_factory=list)
     #: keys resolved ON DEVICE by the widened-K re-replay ladder
     escalated: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: keys served from the HBM-resident state cache (exact hits replay
+    #: nothing; suffix hits replay only the appended batches)
+    resident: List[Tuple[str, str, str]] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -110,6 +115,12 @@ class TPUReplayEngine:
         self.layout = layout
         self.pack_cache = PackCache()
         self.ladder = EscalationLadder(layout)
+        #: HBM-resident per-workflow states: verify_all serves unchanged
+        #: workflows from the cache and replays only appended batches for
+        #: suffix hits; full replay remains the cold-miss and
+        #: parity-audit path (engine/resident.py)
+        self.resident = ResidentStateCache(layout, ladder=self.ladder,
+                                           pipeline_depth=pipeline_depth)
         self.metrics = m.DEFAULT_REGISTRY
         self.chunk_workflows = (chunk_workflows if chunk_workflows
                                 else int(os.environ.get(CHUNK_ENV,
@@ -133,6 +144,8 @@ class TPUReplayEngine:
         self._metrics = registry
         self.pack_cache.metrics = registry
         self.ladder.metrics = registry
+        if hasattr(self, "resident"):
+            self.resident.metrics = registry
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [
@@ -310,6 +323,44 @@ class TPUReplayEngine:
                                  for r, (lo, hi) in zip(results, spans)])
         return rows, errors, branch
 
+    def _expected_row(self, key: Tuple[str, str, str]
+                      ) -> Tuple[np.ndarray, int]:
+        """The live mutable state's canonical payload row (sticky masked:
+        replay always clears stickiness) and current branch index."""
+        live_ms = self.stores.execution.get_workflow(*key)
+        row = payload_row(live_ms, self.layout)
+        row[STICKY_ROW_INDEX] = 0
+        return row, live_ms.version_histories.current_index
+
+    def _partition_resident(self, keys: List[Tuple[str, str, str]]):
+        """Split keys by what the resident cache can serve: exact hits
+        (no device work), suffix hits (replay appended batches only),
+        and cold keys for the full-replay path. Non-single-lineage keys
+        (an NDC branch switch happened since the state was pinned) and
+        stale addresses (tail overwrite, reset rewrite) invalidate their
+        entries here — the cache never serves across those mutations."""
+        exact: List[Tuple[Tuple[str, str, str], object]] = []
+        suffix: List[Tuple[Tuple[str, str, str], object, list]] = []
+        cold: List[Tuple[str, str, str]] = []
+        addresses: dict = {}
+        hs = self.stores.history
+        for key in keys:
+            if (hs.branch_count(*key) > 1
+                    or hs.get_current_branch(*key) != 0):
+                self.resident.invalidate(key)  # NDC branch switch
+                cold.append(key)
+                continue
+            batches = hs.as_history_batches(*key)
+            hit = self.resident.lookup(key, batches)
+            if hit is None:
+                addresses[key] = content_address(batches)
+                cold.append(key)
+            elif hit[0] == "exact":
+                exact.append((key, hit[1]))
+            else:
+                suffix.append((key, hit[1], batches))
+        return exact, suffix, cold, addresses
+
     def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
                    ) -> BulkVerifyResult:
         """Replay persisted histories on device and compare against the live
@@ -317,6 +368,14 @@ class TPUReplayEngine:
         ON DEVICE: expected payload rows ship with the corpus and the host
         reads back a mismatch bitmap plus the error lanes — not the full
         [W, width] payload tensor.
+
+        Incremental serving path: workflows whose final state is pinned
+        in the HBM-resident cache (engine/resident.py) skip full replay —
+        an unchanged history verifies against the cached payload with
+        zero device work, an appended history replays ONLY the new
+        batches against the resident state (O(new events) per
+        transaction). Cold misses run the full chunked path below and
+        seed the cache from their verified final states.
 
         Capacity-flagged rows (pending-table / version-history / branch
         overflow) escalate through the widened-K ladder: their rung-1
@@ -329,9 +388,47 @@ class TPUReplayEngine:
         oracle."""
         if keys is None:
             keys = self.stores.execution.list_executions()
-        keys = list(keys)
-        if not keys:
+        all_keys = list(keys)
+        if not all_keys:
             return BulkVerifyResult(total=0, verified_on_device=0)
+        result = BulkVerifyResult(total=len(all_keys), verified_on_device=0)
+        if resident_mod.enabled():
+            exact, suffix, keys, addresses = \
+                self._partition_resident(all_keys)
+        else:
+            exact, suffix, keys, addresses = [], [], all_keys, {}
+
+        for key, entry in exact:
+            row, br = self._expected_row(key)
+            result.verified_on_device += 1
+            result.resident.append(key)
+            if not (entry.payload == row).all() or entry.branch != br:
+                result.divergent.append(key)
+
+        if suffix:
+            outcomes = self.resident.replay_append(
+                suffix, encode_suffix=self.pack_cache.encode_suffix)
+            for (key, _entry, batches), res in zip(suffix, outcomes):
+                row, br = self._expected_row(key)
+                if not res.ok:
+                    # entry already invalidated; the per-workflow oracle
+                    # arbitrates, exactly like the cold path's residue
+                    result.device_errors.append((key, int(res.error)))
+                    result.fallback.append(key)
+                    oracle_ms = StateBuilder().replay_history(batches)
+                    if not (payload_row(oracle_ms, self.layout)
+                            == row).all():
+                        result.divergent.append(key)
+                    continue
+                result.verified_on_device += 1
+                result.resident.append(key)
+                if res.escalated:
+                    result.escalated.append(key)
+                if not (res.payload == row).all() or res.branch != br:
+                    result.divergent.append(key)
+
+        if not keys:
+            return result
         spans = self._chunk_spans(len(keys))
         #: ci -> (capacity-flagged local indices, pending rung-1 dispatch)
         pending: dict = {}
@@ -368,21 +465,33 @@ class TPUReplayEngine:
             mismatch = verify_rows(rows_dev, jnp.asarray(expected),
                                    state.current_branch,
                                    jnp.asarray(exp_branch))
-            return mismatch, state.error, expected, exp_branch
+            return mismatch, state.error, expected, exp_branch, state
 
         def readback(outs):
-            mismatch_dev, err_dev, expected, exp_branch = outs
+            mismatch_dev, err_dev, expected, exp_branch, state = outs
             return (np.asarray(mismatch_dev), np.asarray(err_dev),
-                    expected, exp_branch)
+                    expected, exp_branch, state)
 
         def escalate(ci, corpus, consumed):
-            _mismatch, errors, _expected, _exp_branch = consumed
+            mismatch, errors, expected, exp_branch, state = consumed
             lo, hi = spans[ci]
             cap = self.ladder.capacity_flagged(errors[:hi - lo])
             if len(cap):
                 pending[ci] = (cap, self.ladder.submit(
                     gather_subcorpus(corpus, cap)))
-            return consumed
+            # seed the resident cache from this chunk's verified-clean
+            # rows: the device row equals the shipped expected row
+            # whenever the mismatch bit is clear, so admission costs one
+            # state-row slice per key and zero extra readback. The state
+            # reference is dropped here (the ring keeps O(depth) alive).
+            for j, key in enumerate(keys[lo:hi]):
+                if (errors[j] == 0 and not mismatch[j]
+                        and key in addresses):
+                    self.resident.admit(
+                        key, addresses[key],
+                        self.resident.extract_row(state, j),
+                        expected[j], int(exp_branch[j]))
+            return mismatch, errors, expected, exp_branch
 
         results, spans = self._run_chunks(keys, pack_extra, launch,
                                           readback, escalate)
@@ -395,7 +504,6 @@ class TPUReplayEngine:
                     resolved[(ci, int(j))] = (outcome.rows[k],
                                               outcome.branch[k])
 
-        result = BulkVerifyResult(total=len(keys), verified_on_device=0)
         for ci, ((lo, hi), (mismatch, errors, expected, exp_branch)
                  ) in enumerate(zip(spans, results)):
             for j, key in enumerate(keys[lo:hi]):

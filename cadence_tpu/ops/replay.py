@@ -160,6 +160,103 @@ def replay_wirec_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
     return crc32_rows(payload_rows(s, layout)), s.error
 
 
+# ---------------------------------------------------------------------------
+# Incremental (from-state) replay: the O(new-events) append kernels.
+#
+# The existing kernels all start from init_state — O(history) per call.
+# These take a CARRIED initial state instead (the HBM-resident
+# per-workflow states engine/resident.py pins between calls), so an
+# append-transaction replays only the new batches: the device analogue
+# of the reference applying just the new events to the execution cache's
+# warm mutable state (historyEngine + execution/cache.go) instead of
+# rebuilding from event 0.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def replay_from_state(events: jnp.ndarray, s0: ReplayState) -> ReplayState:
+    """Replay packed suffix events [W, E, L] against carried state `s0`
+    (whose shapes imply the layout — base or ladder-widened); returns the
+    final state. With s0 = init_state this is exactly replay_events."""
+    s, _ = jax.lax.scan(_scan_body, s0, jnp.swapaxes(events, 0, 1))
+    return s
+
+
+@partial(jax.jit, static_argnames=("out_layout",))
+def replay_from_state_to_payload(events: jnp.ndarray, s0: ReplayState,
+                                 out_layout: PayloadLayout = DEFAULT_LAYOUT):
+    """From-state replay reduced to the serving shape: (final state,
+    payload rows at `out_layout` width, error [W], narrow_overflow [W]).
+    The state may be ladder-widened; the payload always projects to the
+    BASE width the oracle and stored checksums use — same contract as
+    replay_escalated."""
+    from .payload import payload_rows_narrow
+
+    s = replay_from_state(events, s0)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return s, rows, s.error, ovf
+
+
+@partial(jax.jit, static_argnames=("out_layout",))
+def replay_from_state_to_crc(events: jnp.ndarray, s0: ReplayState,
+                             out_layout: PayloadLayout = DEFAULT_LAYOUT
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                        jnp.ndarray]:
+    """From-state replay reduced to (crc32 [W] uint32, error [W],
+    narrow_overflow [W]) — the minimal-readback append transaction:
+    suffix lanes up, 4 bytes/workflow down."""
+    from .crc import crc32_rows
+    from .payload import payload_rows_narrow
+
+    s = replay_from_state(events, s0)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return crc32_rows(rows), s.error, ovf
+
+
+@partial(jax.jit, static_argnames=("profile",))
+def replay_wirec_from_state(slab: jnp.ndarray, bases: jnp.ndarray,
+                            n_events: jnp.ndarray, profile,
+                            s0: ReplayState) -> ReplayState:
+    """From-state replay of a wirec-compressed SUFFIX corpus: the suffix
+    packs as its own corpus (bases are its first-row values), so decode
+    is self-contained and only the appended batches' compressed bytes
+    ever cross the link."""
+    from .wirec import decode_step, delta_base_columns
+
+    W, E, _ = slab.shape
+    cols = delta_base_columns(profile)
+    prev0 = (bases[:, list(cols)] if cols
+             else jnp.zeros((W, 0), dtype=jnp.int64))
+
+    def body(carry, xs):
+        s, prev = carry
+        sl, e_idx = xs
+        ev, prev = decode_step(sl, prev, bases, n_events, e_idx, profile)
+        return (step(s, ev), prev), None
+
+    (s, _), _ = jax.lax.scan(
+        body, (s0, prev0),
+        (jnp.swapaxes(slab, 0, 1), jnp.arange(E, dtype=n_events.dtype)))
+    return s
+
+
+@partial(jax.jit, static_argnames=("profile", "out_layout"))
+def replay_wirec_from_state_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
+                                   n_events: jnp.ndarray, profile,
+                                   s0: ReplayState,
+                                   out_layout: PayloadLayout = DEFAULT_LAYOUT
+                                   ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                              jnp.ndarray]:
+    """wirec from-state replay reduced to (crc32 [W] uint32, error [W],
+    narrow_overflow [W])."""
+    from .crc import crc32_rows
+    from .payload import payload_rows_narrow
+
+    s = replay_wirec_from_state(slab, bases, n_events, profile, s0)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return crc32_rows(rows), s.error, ovf
+
+
 @partial(jax.jit, static_argnames=("layout", "out_layout"))
 def replay_escalated(events: jnp.ndarray, layout: PayloadLayout,
                      out_layout: PayloadLayout = DEFAULT_LAYOUT

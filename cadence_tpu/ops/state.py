@@ -280,6 +280,68 @@ def layout_of(s: ReplayState) -> PayloadLayout:
     )
 
 
+def widen_state(s: ReplayState, out_layout: PayloadLayout) -> ReplayState:
+    """Re-home a carried state at a WIDER layout: every table keeps its
+    occupied slots at their original indices and gains empty slots past
+    the old capacity (occ False, PAD for version-history items) — so
+    replaying appended events from the widened state is exactly replaying
+    them with more headroom, never a different history. This is how the
+    escalation ladder keeps capacity-flagged RESIDENT states on device
+    (engine/resident.py): the pre-append state widens, the suffix
+    re-replays at 2K/4K, and the row stays in HBM instead of falling
+    back to a full-history re-replay."""
+    import jax
+
+    fresh = init_state(s.state.shape[0], out_layout)
+
+    def widen(cur, new):
+        if cur.shape == new.shape:
+            return cur
+        return new.at[tuple(slice(0, d) for d in cur.shape)].set(cur)
+
+    return jax.tree_util.tree_map(widen, s, fresh)
+
+
+def narrow_ok(s: ReplayState, out_layout: PayloadLayout) -> jnp.ndarray:
+    """[W] bool: rows whose state fits `out_layout` EXACTLY — no occupied
+    table slot, version-history item, or branch beyond the narrow
+    capacities — so narrow_state() on them is lossless (the re-narrow
+    half of the ladder's widen/re-narrow round trip: an escalated
+    resident row whose pending load drained back under base K returns to
+    base-width HBM footprint)."""
+    Kv = out_layout.max_version_history_items
+    B = out_layout.max_branches
+    ok = s.current_branch < B
+    if s.vh_count.shape[1] > B:
+        ok &= (s.vh_count[:, B:] == 0).all(axis=1)
+    ok &= (s.vh_count <= Kv).all(axis=1)
+    for table, cap in ((s.activities, out_layout.max_activities),
+                       (s.timers, out_layout.max_timers),
+                       (s.children, out_layout.max_children),
+                       (s.cancels, out_layout.max_request_cancels),
+                       (s.signals, out_layout.max_signals)):
+        if table.occ.shape[1] > cap:
+            ok &= ~table.occ[:, cap:].any(axis=1)
+    return ok
+
+
+def narrow_state(s: ReplayState, out_layout: PayloadLayout) -> ReplayState:
+    """Slice a widened state down to `out_layout`. Only valid for rows
+    where narrow_ok() holds — slots past the narrow capacities are
+    dropped, so an occupied one would silently vanish (callers gate on
+    the mask; engine/resident.py keeps non-narrowable rows widened)."""
+    import jax
+
+    fresh = init_state(s.state.shape[0], out_layout)
+
+    def narrow(cur, new):
+        if cur.shape == new.shape:
+            return cur
+        return cur[tuple(slice(0, d) for d in new.shape)]
+
+    return jax.tree_util.tree_map(narrow, s, fresh)
+
+
 def reset_rows(s: ReplayState, mask: jnp.ndarray) -> ReplayState:
     """Blend fresh init values into the rows where `mask` holds — the
     continue-as-new run boundary (the reference builds a brand-new

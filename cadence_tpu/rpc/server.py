@@ -201,6 +201,18 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                                     "deadline-expired-rejections"),
                                    ("rpc.circuitbreaker", "transitions")):
             self.metrics.inc(scope_name, metric, 0)
+        # resident-state cache series likewise pre-registered: scrapes
+        # show tpu.resident/* as zero before the first verify touches it
+        from ..utils import metrics as cm
+        for metric in (cm.M_CACHE_HITS, cm.M_RESIDENT_SUFFIX_HITS,
+                       cm.M_CACHE_MISSES, cm.M_CACHE_EVICTIONS,
+                       cm.M_CACHE_INVALIDATIONS,
+                       cm.M_RESIDENT_EVENTS_APPENDED,
+                       cm.M_RESIDENT_WIDENED, cm.M_RESIDENT_NARROWED):
+            self.metrics.inc(cm.SCOPE_TPU_RESIDENT, metric, 0)
+        for gauge in (cm.M_RESIDENT_BYTES, cm.M_RESIDENT_ENTRIES,
+                      cm.M_RESIDENT_BUDGET_BYTES):
+            self.metrics.gauge(cm.SCOPE_TPU_RESIDENT, gauge, 0.0)
         # wire chaos can also arrive via dynamicconfig (the env var is the
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)

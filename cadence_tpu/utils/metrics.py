@@ -41,6 +41,7 @@ SCOPE_TPU_REPLAY = "tpu.replay-engine"
 SCOPE_REBUILD = "tpu.device-rebuilder"
 SCOPE_PACK_CACHE = "tpu.pack-cache"
 SCOPE_TPU_FALLBACK = "tpu.fallback"
+SCOPE_TPU_RESIDENT = "tpu.resident"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -92,6 +93,20 @@ M_CACHE_HITS = "hits"
 M_CACHE_MISSES = "misses"
 M_CACHE_EVICTIONS = "evictions"
 M_CACHE_SUFFIX_PACKS = "suffix-packs"
+#: resident-state cache counters (engine/resident.py ResidentStateCache,
+#: SCOPE_TPU_RESIDENT): exact hits reuse the cached payload with zero
+#: device work, suffix hits replay only appended batches against the
+#: HBM-resident state, invalidations count stale entries dropped on tail
+#: overwrite / reset / NDC branch switch; the resident-bytes gauge is
+#: the cache's HBM footprint against its configured budget
+M_CACHE_INVALIDATIONS = "invalidations"
+M_RESIDENT_SUFFIX_HITS = "suffix-hits"
+M_RESIDENT_BYTES = "resident-bytes"
+M_RESIDENT_ENTRIES = "resident-entries"
+M_RESIDENT_BUDGET_BYTES = "budget-bytes"
+M_RESIDENT_EVENTS_APPENDED = "events-appended"
+M_RESIDENT_WIDENED = "widened-rows"
+M_RESIDENT_NARROWED = "renarrowed-rows"
 #: capacity-escalation ladder counters (engine/ladder.py,
 #: SCOPE_TPU_FALLBACK): rows entering the ladder, rows re-replayed at
 #: each rung (metric name ladder_rung_rows(r)), rows resolved on device,

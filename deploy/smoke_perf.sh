@@ -11,7 +11,11 @@
 #     warm trials recompile nothing, and fallback_under_pressure
 #     .mixed_rate_median stays within PERF_TOLERANCE of the baseline's —
 #     CI catches a reintroduced overflow cliff (BENCH_r05's 3x collapse)
-#     right here.
+#     right here;
+#   - the incremental gate holds: append transactions through the
+#     resident-state cache cost O(new events) — long-history appends
+#     within 1.5x of short-history appends at equal suffix size
+#     (detail.incremental in the recorded JSON).
 # The assertions live in tests/test_perf_gate.py, marked `perf`.
 #
 # Usage: deploy/smoke_perf.sh [baseline.json] [extra pytest args]
@@ -32,6 +36,9 @@ env BENCH_NS_WORKFLOWS="${BENCH_NS_WORKFLOWS:-16384}" \
     BENCH_NS_CHUNK="${BENCH_NS_CHUNK:-4096}" \
     BENCH_SUITE_WORKFLOWS="${BENCH_SUITE_WORKFLOWS:-16384}" \
     BENCH_TRIALS="${BENCH_TRIALS:-3}" \
+    BENCH_INCR_WORKFLOWS="${BENCH_INCR_WORKFLOWS:-512}" \
+    BENCH_INCR_SHORT="${BENCH_INCR_SHORT:-32}" \
+    BENCH_INCR_LONG="${BENCH_INCR_LONG:-256}" \
     python bench.py > "$OUT"
 
 exec env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \

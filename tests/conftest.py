@@ -51,6 +51,11 @@ def _fresh_default_observability():
     # armed kill site must never fire inside another test's WAL append
     from cadence_tpu.engine import crashpoints
     crashpoints.uninstall()
+    # resident-state caches pin DEVICE buffers per entry; clear every
+    # live cache so one test's HBM residents (and their hit/miss state)
+    # never leak into another's assertions or memory budget
+    from cadence_tpu.engine import resident
+    resident.reset_all()
     yield
 
 

@@ -262,25 +262,33 @@ def box():
 
 class TestVerifyAllExecutor:
     def test_warm_verify_hits_pack_cache_and_suffix_packs(self, box):
-        """Acceptance: a warm re-verify of an unchanged corpus hits the
-        pack cache (hit counter > 0 on /metrics) and skips repacking;
-        appending one batch repacks only the suffix."""
+        """Acceptance: a warm re-verify of an unchanged corpus is served
+        by the resident-state cache (exact hits, zero repacking); an
+        appended batch takes the suffix path end to end — a resident
+        suffix hit whose lanes come from the pack cache's suffix repack
+        (engine/cache.encode_suffix), so BOTH caches' counters move."""
         box.frontend.start_workflow_execution(DOMAIN, "wf-cache", "t", TL)
-        assert box.tpu.verify_all().ok
+        result = box.tpu.verify_all()
+        assert result.ok
         reg = box.tpu.pack_cache.metrics
         assert reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_MISSES) >= 1
         assert reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_HITS) == 0
+        assert not result.resident  # cold: nothing was pinned yet
 
-        assert box.tpu.verify_all().ok  # unchanged corpus: pure hits
-        hits = reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_HITS)
-        assert hits >= 1
-        assert 'cadence_hits_total{scope="tpu.pack-cache"}' in \
+        # unchanged corpus: pure resident exact hits, no repacking
+        result = box.tpu.verify_all()
+        assert result.ok and result.resident
+        assert reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_HITS) >= 1
+        assert 'cadence_hits_total{scope="tpu.resident"}' in \
             reg.to_prometheus()
 
-        # append one batch (a signal) — only the suffix repacks
+        # append one batch (a signal) — only the suffix repacks, and it
+        # replays against the resident state instead of from event 0
         box.frontend.signal_workflow_execution(DOMAIN, "wf-cache", "go")
         assert box.tpu.verify_all().ok
         assert reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_SUFFIX_PACKS) >= 1
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_RESIDENT_SUFFIX_HITS) >= 1
 
     def test_divergence_detected_via_device_bitmap(self, box):
         """verify_all compares on device now; a tampered live state must

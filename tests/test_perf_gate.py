@@ -16,7 +16,13 @@ Three layers:
    also stay within tolerance of the baseline: a reintroduced overflow
    cliff (BENCH_r05's 3x collapse) fails CI here.
 
-3. Baseline regression gate: when PERF_CURRENT / PERF_BASELINE point at
+3. Incremental (O(new events)) gate: append transactions through the
+   HBM-resident state cache must cost by APPENDED events, not history
+   length — equal suffixes launch identical shapes (structural, always
+   on) and long-history appends stay within 1.5x of short-history
+   appends (in-process and against the recorded bench JSON).
+
+4. Baseline regression gate: when PERF_CURRENT / PERF_BASELINE point at
    bench JSON files (the smoke script runs the small bench and wires the
    output next to the BENCH_r*.json trajectory), every common suite's
    `transfer_included_rate` must stay within PERF_TOLERANCE (default
@@ -134,6 +140,51 @@ class TestFallbackGate:
                 f"regressed below {tol:.0%} of baseline "
                 f"{base['mixed_rate_median']} — the overflow cliff is "
                 f"back")
+
+
+class TestIncrementalGate:
+    """The O(new events) gate (ISSUE 6): an append transaction's replay
+    cost must scale with the APPENDED events, not the total history
+    length. Structural half always runs (launched suffix shapes are
+    deterministic); the timing half compares long-history vs
+    short-history appends at equal suffix size within 1.5x."""
+
+    def test_append_cost_o_new_events(self):
+        import bench
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+
+        res = bench._incremental_suite(DEFAULT_LAYOUT, workflows=48,
+                                       short_events=24, long_events=160,
+                                       txns=12)
+        # structural: equal suffixes launch IDENTICAL corpus shapes no
+        # matter the underlying history length — the device work cannot
+        # depend on history size
+        assert res["shapes_equal"], (res["short"]["chunk_shape"],
+                                     res["long"]["chunk_shape"])
+        assert res["short"]["chunk_shape"][1] <= 16
+        # history lengths genuinely differ; suffix sizes don't
+        assert res["long"]["history_events_mean"] \
+            >= 4 * res["short"]["history_events_mean"]
+        # timing: long-history appends within 1.5x of short-history
+        # appends (+10ms absolute slack for shared-box scheduling noise;
+        # the launched work is identical, so this is generous)
+        p50_s = res["short"]["append_p50_ms"]
+        p50_l = res["long"]["append_p50_ms"]
+        assert p50_l <= max(1.5 * p50_s, p50_s + 10.0), (
+            f"long-history append p50 {p50_l}ms vs short {p50_s}ms — "
+            f"append cost is scaling with history length")
+
+    def test_incremental_recorded_in_bench_json(self):
+        """smoke_perf.sh's recorded run must carry the incremental suite
+        and hold the same ratio gate (hardware-pinned CI)."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("incremental")
+        assert cur, "current bench carries no incremental suite"
+        assert cur["shapes_equal"]
+        p50_s = cur["short"]["append_p50_ms"]
+        p50_l = cur["long"]["append_p50_ms"]
+        assert p50_l <= max(1.5 * p50_s, p50_s + 10.0), (
+            f"recorded long-history append p50 {p50_l}ms regressed past "
+            f"1.5x of short {p50_s}ms")
 
 
 class TestBaselineGate:

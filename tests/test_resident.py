@@ -1,0 +1,699 @@
+"""Device-resident incremental replay (ISSUE 6).
+
+Covers: the from-state kernel family (ops/replay.replay_from_state*,
+dense + wirec) replaying suffixes byte-identically to full-history
+replay; ResidentStateCache content-address semantics (exact / suffix /
+stale), LRU eviction under the HBM budget, and invalidation on tail
+overwrite / reset / NDC branch switch through verify_all; the
+capacity-escalation ladder widening a resident state on an overflowing
+append and re-narrowing it once the load drains; the pipelined executor
+packing only suffix batches at depth >= 2; the rebuilder's resident
+consult; and the tpu.resident/* metrics surface.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    crc32_of_rows,
+    payload_row,
+)
+from cadence_tpu.core.enums import EventType
+from cadence_tpu.engine.cache import (
+    ContentAddress,
+    address_relation,
+    content_address,
+)
+from cadence_tpu.engine.ladder import EscalationLadder
+from cadence_tpu.engine.resident import ResidentStateCache
+from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.ops.encode import assemble_corpus, encode_batches_resumable
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.utils import metrics as m
+
+DOMAIN = "res-domain"
+TL = "res-tl"
+
+
+def _oracle_row(batches, layout=DEFAULT_LAYOUT):
+    ms = StateBuilder().replay_history(batches)
+    row = payload_row(ms, layout)
+    row[STICKY_ROW_INDEX] = 0
+    return row
+
+
+def _replay_full(hists):
+    """Full-history device replay -> (state, payload rows np)."""
+    import jax.numpy as jnp
+
+    from cadence_tpu.ops.payload import payload_rows
+    from cadence_tpu.ops.replay import replay_events
+
+    rows_list = [encode_batches_resumable(h)[0] for h in hists]
+    corpus = assemble_corpus(rows_list,
+                             max(r.shape[0] for r in rows_list))
+    s = replay_events(jnp.asarray(corpus))
+    return s, np.asarray(payload_rows(s))
+
+
+def _seed_cache(cache, keys, prefix_hists):
+    """Pin every workflow's prefix state (the cold-path admission the
+    engine does from verify_all, done directly)."""
+    s, rows = _replay_full(prefix_hists)
+    branch = np.asarray(s.current_branch)
+    assert (np.asarray(s.error) == 0).all()
+    for i, key in enumerate(keys):
+        assert cache.admit(key, content_address(prefix_hists[i]),
+                           cache.extract_row(s, i), rows[i],
+                           int(branch[i]))
+
+
+# ---------------------------------------------------------------------------
+# from-state kernels: suffix replay == full replay, dense and wirec
+# ---------------------------------------------------------------------------
+
+
+class TestFromStateKernels:
+    @pytest.mark.parametrize("suite", ["basic", "timer_retry",
+                                       "concurrent_child", "ndc"])
+    def test_dense_suffix_parity_every_suite(self, suite):
+        """replay_from_state over the appended batches must land on the
+        exact payload bytes of a full-history replay — the correctness
+        gate of the whole subsystem, per workload suite."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.ops.replay import (
+            replay_events,
+            replay_from_state_to_payload,
+        )
+
+        hists = generate_corpus(suite, num_workflows=8, seed=11,
+                                target_events=40)
+        _, rows_full = _replay_full(hists)
+
+        prefixes = [encode_batches_resumable(h[:-1]) for h in hists]
+        pref = assemble_corpus([r for r, _ in prefixes],
+                               max(r.shape[0] for r, _ in prefixes))
+        s_pref = replay_events(jnp.asarray(pref))
+        suffix_rows = [encode_batches_resumable(h[-1:], mp)[0]
+                       for h, (_, mp) in zip(hists, prefixes)]
+        suf = assemble_corpus(suffix_rows,
+                              max(r.shape[0] for r in suffix_rows))
+        _s, rows, err, ovf = replay_from_state_to_payload(
+            jnp.asarray(suf), s_pref, DEFAULT_LAYOUT)
+        assert (np.asarray(err) == 0).all()
+        assert not np.asarray(ovf).any()
+        assert (np.asarray(rows) == rows_full).all()
+        for i, h in enumerate(hists):
+            assert (np.asarray(rows)[i] == _oracle_row(h)).all()
+
+    def test_wirec_suffix_crc_parity(self):
+        """The compressed-wire variant: suffix packs as its own wirec
+        corpus and the from-state CRC matches full replay bit for bit."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.ops.replay import (
+            replay_events,
+            replay_wirec_from_state_to_crc,
+        )
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        hists = generate_corpus("echo_signal", num_workflows=6, seed=5,
+                                target_events=32)
+        _, rows_full = _replay_full(hists)
+        crc_full = crc32_of_rows(rows_full)
+
+        prefixes = [encode_batches_resumable(h[:-1]) for h in hists]
+        pref = assemble_corpus([r for r, _ in prefixes],
+                               max(r.shape[0] for r, _ in prefixes))
+        s_pref = replay_events(jnp.asarray(pref))
+        suffix_rows = [encode_batches_resumable(h[-1:], mp)[0]
+                       for h, (_, mp) in zip(hists, prefixes)]
+        suf = assemble_corpus(suffix_rows,
+                              max(r.shape[0] for r in suffix_rows))
+        wc = pack_wirec(suf)
+        crc, err, ovf = replay_wirec_from_state_to_crc(
+            jnp.asarray(wc.slab), jnp.asarray(wc.bases),
+            jnp.asarray(wc.n_events), wc.profile, s_pref, DEFAULT_LAYOUT)
+        assert (np.asarray(err) == 0).all()
+        assert not np.asarray(ovf).any()
+        assert (np.asarray(crc).astype(np.uint32) == crc_full).all()
+
+    def test_widen_then_suffix_replay_then_narrow(self):
+        """A base state widened to 2K replays the suffix to the same
+        base-width payload, and narrow_state round-trips it back."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.ops.payload import payload_rows
+        from cadence_tpu.ops.replay import (
+            replay_events,
+            replay_from_state_to_payload,
+        )
+        from cadence_tpu.ops.state import (
+            layout_of,
+            narrow_ok,
+            narrow_state,
+            widen_layout,
+            widen_state,
+        )
+
+        hists = generate_corpus("timer_retry", num_workflows=5, seed=7,
+                                target_events=36)
+        _, rows_full = _replay_full(hists)
+        prefixes = [encode_batches_resumable(h[:-1]) for h in hists]
+        pref = assemble_corpus([r for r, _ in prefixes],
+                               max(r.shape[0] for r, _ in prefixes))
+        s_pref = replay_events(jnp.asarray(pref))
+        wide = widen_layout(DEFAULT_LAYOUT, 2)
+        s_wide = widen_state(s_pref, wide)
+        assert layout_of(s_wide) == wide
+        suffix_rows = [encode_batches_resumable(h[-1:], mp)[0]
+                       for h, (_, mp) in zip(hists, prefixes)]
+        suf = assemble_corpus(suffix_rows,
+                              max(r.shape[0] for r in suffix_rows))
+        s_fin, rows, err, _ovf = replay_from_state_to_payload(
+            jnp.asarray(suf), s_wide, DEFAULT_LAYOUT)
+        assert (np.asarray(err) == 0).all()
+        assert (np.asarray(rows) == rows_full).all()
+        assert np.asarray(narrow_ok(s_fin, DEFAULT_LAYOUT)).all()
+        s_narrow = narrow_state(s_fin, DEFAULT_LAYOUT)
+        assert layout_of(s_narrow) == DEFAULT_LAYOUT
+        assert (np.asarray(payload_rows(s_narrow)) == rows_full).all()
+
+
+# ---------------------------------------------------------------------------
+# content-address + cache unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestContentAddress:
+    def test_relations(self):
+        hists = generate_corpus("basic", num_workflows=1, seed=3,
+                                target_events=24)
+        h = hists[0]
+        addr = content_address(h[:-1])
+        assert addr == ContentAddress(len(h) - 1,
+                                      content_address(h[:-1]).last_batch_crc)
+        assert address_relation(addr, h[:-1]) == "exact"
+        assert address_relation(addr, h) == "prefix"
+        # fewer batches than cached: stale
+        assert address_relation(content_address(h), h[:-1]) == "stale"
+        # overwritten tail at the cached position: stale
+        mutated = list(h[:-2]) + [h[-1]]
+        assert address_relation(addr, mutated) == "stale"
+
+    def test_packcache_and_resident_share_the_helper(self):
+        """The drift guard: both caches must address through the SAME
+        functions (no private copies of the tuple logic)."""
+        import inspect
+
+        from cadence_tpu.engine import cache as cache_mod
+        from cadence_tpu.engine import resident as resident_mod
+
+        src_pack = inspect.getsource(cache_mod.PackCache)
+        src_res = inspect.getsource(resident_mod.ResidentStateCache)
+        assert "address_relation" in src_pack
+        assert "address_relation" in src_res or \
+            "address_relation" in inspect.getsource(
+                resident_mod.ResidentStateCache.lookup)
+        assert "_batch_crc" not in src_pack  # the old private copy is gone
+
+
+class TestResidentCacheUnit:
+    def _cache(self, **kw):
+        kw.setdefault("ladder", EscalationLadder(DEFAULT_LAYOUT))
+        return ResidentStateCache(DEFAULT_LAYOUT, **kw)
+
+    def test_lookup_exact_suffix_stale(self):
+        cache = self._cache()
+        hists = generate_corpus("basic", num_workflows=2, seed=13,
+                                target_events=24)
+        keys = [("d", f"w{i}", "r") for i in range(2)]
+        _seed_cache(cache, keys, [h[:-1] for h in hists])
+        reg = cache.metrics
+
+        kind, entry = cache.lookup(keys[0], hists[0][:-1])
+        assert kind == "exact"
+        assert (entry.payload == _oracle_row(hists[0][:-1])).all()
+        kind, _ = cache.lookup(keys[0], hists[0])
+        assert kind == "suffix"
+        assert reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_HITS) == 1
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_RESIDENT_SUFFIX_HITS) == 1
+
+        # tail overwrite: stale -> entry invalidated, then a clean miss
+        mutated = list(hists[1][:-2]) + [hists[1][-1]]
+        assert cache.lookup(keys[1], mutated) is None
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_CACHE_INVALIDATIONS) == 1
+        assert cache.lookup(keys[1], hists[1][:-1]) is None  # dropped
+        assert reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_MISSES) == 2
+
+        # non-authoritative prefix lookups (rebuild at a reset point)
+        # must NOT invalidate the entry
+        assert cache.lookup(keys[0], hists[0][:1],
+                            authoritative=False) is None
+        assert cache.lookup(keys[0], hists[0][:-1])[0] == "exact"
+
+    def test_lru_eviction_at_budget(self):
+        probe = self._cache()
+        row_bytes = probe._row_nbytes(DEFAULT_LAYOUT)
+        cache = self._cache(budget_bytes=3 * row_bytes + 1)
+        hists = generate_corpus("basic", num_workflows=5, seed=17,
+                                target_events=20)
+        keys = [("d", f"w{i}", "r") for i in range(5)]
+        _seed_cache(cache, keys, [h[:-1] for h in hists])
+        assert len(cache) == 3
+        assert cache.resident_bytes <= cache.budget_bytes
+        reg = cache.metrics
+        assert reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_EVICTIONS) == 2
+        # LRU order: the first two admitted were evicted
+        assert cache.lookup(keys[0], hists[0][:-1]) is None
+        assert cache.lookup(keys[4], hists[4][:-1])[0] == "exact"
+        assert reg.gauge_value(m.SCOPE_TPU_RESIDENT,
+                               m.M_RESIDENT_BYTES) == cache.resident_bytes
+        assert reg.gauge_value(m.SCOPE_TPU_RESIDENT,
+                               m.M_RESIDENT_ENTRIES) == 3
+
+    def test_oversized_budget_rejects_admission(self):
+        cache = self._cache(budget_bytes=16)  # smaller than any row
+        hists = generate_corpus("basic", num_workflows=1, seed=19,
+                                target_events=20)
+        s, rows = _replay_full([hists[0][:-1]])
+        assert not cache.admit(("d", "w", "r"),
+                               content_address(hists[0][:-1]),
+                               cache.extract_row(s, 0), rows[0], 0)
+        assert len(cache) == 0
+
+    def test_replay_append_parity_and_readdress(self):
+        cache = self._cache()
+        hists = generate_corpus("concurrent_child", num_workflows=4,
+                                seed=23, target_events=40)
+        keys = [("d", f"w{i}", "r") for i in range(4)]
+        _seed_cache(cache, keys, [h[:-1] for h in hists])
+        items = [(k, cache.lookup(k, h)[1], h)
+                 for k, h in zip(keys, hists)]
+        results = cache.replay_append(items)
+        for h, res in zip(hists, results):
+            assert res.ok and not res.escalated
+            assert (res.payload == _oracle_row(h)).all()
+        # entries re-addressed at the full history: exact hits now
+        for k, h in zip(keys, hists):
+            assert cache.lookup(k, h)[0] == "exact"
+        assert cache.last_append.events_appended == sum(
+            len(h[-1].events) for h in hists)
+
+
+# ---------------------------------------------------------------------------
+# capacity escalation: widen on overflowing append, stay resident,
+# re-narrow once the load drains
+# ---------------------------------------------------------------------------
+
+
+def _overflow_chain():
+    """A 3-stage history: prefix pins 12 pending activities (fits the
+    base K=16); append-1 schedules 10 more (transient 22 -> TABLE_OVERFLOW
+    at base, fits 2K) and completes the 8 OLDEST (final 14 <= 16 but
+    high table slots stay occupied -> not narrowable); append-2 completes
+    the 6 activities sitting in the widened slots (narrowable again).
+    Returns (prefix, after_append1, after_append2) batch lists."""
+    from cadence_tpu.gen.corpus import (
+        HistoryWriter,
+        _begin_decision_completed_batch,
+        _run_decision,
+        _schedule_decision,
+        _start,
+    )
+
+    w = HistoryWriter(workflow_id="ovf")
+    _start(w, random.Random(0))
+    cyc = _run_decision(w, 2)
+    completed = _begin_decision_completed_batch(w, cyc)
+    prefix_acts = [w.add(
+        EventType.ActivityTaskScheduled, activity_id=f"p{i}",
+        task_list=TL, schedule_to_start_timeout_seconds=60,
+        schedule_to_close_timeout_seconds=120,
+        start_to_close_timeout_seconds=60, heartbeat_timeout_seconds=0,
+    ) for i in range(12)]
+    sched = _schedule_decision(w, in_batch=True)
+    w.end_batch()
+    prefix = list(w.batches)
+
+    def complete(act_ev):
+        started = w.single(EventType.ActivityTaskStarted,
+                           scheduled_event_id=act_ev.id,
+                           request_id=f"poll-{act_ev.id}")
+        w.begin_batch()
+        w.add(EventType.ActivityTaskCompleted, scheduled_event_id=act_ev.id,
+              started_event_id=started.id)
+        w.end_batch()
+
+    cyc = _run_decision(w, sched)
+    _begin_decision_completed_batch(w, cyc)
+    flood_acts = [w.add(
+        EventType.ActivityTaskScheduled, activity_id=f"f{i}",
+        task_list=TL, schedule_to_start_timeout_seconds=60,
+        schedule_to_close_timeout_seconds=120,
+        start_to_close_timeout_seconds=60, heartbeat_timeout_seconds=0,
+    ) for i in range(10)]
+    _schedule_decision(w, in_batch=True)
+    w.end_batch()
+    for ev in prefix_acts[:8]:  # oldest slots free; widened slots stay
+        complete(ev)
+    after_append1 = list(w.batches)
+
+    # the 6 flood activities in widened slots (base indices >= 16 were
+    # taken by flood acts 4..9) drain -> the state can re-narrow
+    for ev in flood_acts[4:]:
+        complete(ev)
+    after_append2 = list(w.batches)
+    return prefix, after_append1, after_append2
+
+
+class TestResidentLadder:
+    def test_overflowing_append_widens_and_renarrows(self):
+        cache = ResidentStateCache(DEFAULT_LAYOUT,
+                                   ladder=EscalationLadder(DEFAULT_LAYOUT))
+        prefix, append1, append2 = _overflow_chain()
+        key = ("d", "ovf", "r")
+        _seed_cache(cache, [key], [prefix])
+        reg = cache.metrics
+
+        # append-1 overflows the base tables: the ladder widens the
+        # RESIDENT state, replays only the suffix, stays resident widened
+        items = [(key, cache.lookup(key, append1)[1], append1)]
+        res = cache.replay_append(items)[0]
+        assert res.ok and res.escalated and res.rung == 1
+        assert (res.payload == _oracle_row(append1)).all()
+        kind, entry = cache.lookup(key, append1)
+        assert kind == "exact" and entry.rung == 1
+        assert reg.counter(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_WIDENED) == 1
+        assert reg.counter(m.SCOPE_TPU_FALLBACK, m.M_LADDER_RESOLVED) >= 1
+        assert cache.stats()["widened_entries"] == 1
+
+        # append-2 replays against the WIDENED resident state, drains the
+        # widened slots, and the state re-narrows to the base footprint
+        items = [(key, entry, append2)]
+        res = cache.replay_append(items)[0]
+        assert res.ok and res.rung == 0
+        assert (res.payload == _oracle_row(append2)).all()
+        kind, entry = cache.lookup(key, append2)
+        assert kind == "exact" and entry.rung == 0
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_RESIDENT_NARROWED) == 1
+        assert cache.stats()["widened_entries"] == 0
+
+    def test_no_ladder_falls_back_cleanly(self):
+        cache = ResidentStateCache(DEFAULT_LAYOUT, ladder=None)
+        prefix, append1, _ = _overflow_chain()
+        key = ("d", "ovf", "r")
+        _seed_cache(cache, [key], [prefix])
+        items = [(key, cache.lookup(key, append1)[1], append1)]
+        res = cache.replay_append(items)[0]
+        assert not res.ok
+        assert len(cache) == 0  # invalidated for oracle arbitration
+
+
+# ---------------------------------------------------------------------------
+# pipelined executor integration: suffix-only packing at depth >= 2
+# ---------------------------------------------------------------------------
+
+
+class TestExecutorIntegration:
+    def test_suffix_chunks_through_pipeline_depth3(self):
+        cache = ResidentStateCache(
+            DEFAULT_LAYOUT, ladder=EscalationLadder(DEFAULT_LAYOUT),
+            chunk_workflows=4, pipeline_depth=3)
+        hists = generate_corpus("basic", num_workflows=12, seed=29,
+                                target_events=48)
+        keys = [("d", f"w{i}", "r") for i in range(12)]
+        _seed_cache(cache, keys, [h[:-1] for h in hists])
+        items = [(k, cache.lookup(k, h)[1], h)
+                 for k, h in zip(keys, hists)]
+        results = cache.replay_append(items)
+        for h, res in zip(hists, results):
+            assert res.ok
+            assert (res.payload == _oracle_row(h)).all()
+        # 12 items / chunk 4 = 3 chunks, each packed to the SUFFIX event
+        # axis (pow2 floor 16), not the 48-event history
+        shapes = cache.last_append.chunk_shapes
+        assert len(shapes) == 3
+        assert all(e <= 16 for _, e in shapes)
+
+    def test_append_shapes_independent_of_history_length(self):
+        """The O(new events) contract, structurally: appending equal-size
+        suffixes to SHORT and LONG histories launches identical suffix
+        corpus shapes — history length never enters the append cost."""
+        shapes = {}
+        for label, target in (("short", 24), ("long", 160)):
+            cache = ResidentStateCache(
+                DEFAULT_LAYOUT, ladder=EscalationLadder(DEFAULT_LAYOUT))
+            hists = generate_corpus("basic", num_workflows=6, seed=31,
+                                    target_events=target)
+            keys = [("d", f"w{i}-{label}", "r") for i in range(6)]
+            _seed_cache(cache, keys, [h[:-1] for h in hists])
+            items = [(k, cache.lookup(k, h)[1], h)
+                     for k, h in zip(keys, hists)]
+            for h, res in zip(hists, cache.replay_append(items)):
+                assert res.ok
+                assert (res.payload == _oracle_row(h)).all()
+            shapes[label] = cache.last_append.chunk_shapes
+        assert shapes["short"] == shapes["long"]
+
+
+# ---------------------------------------------------------------------------
+# verify_all integration: invalidation on tail overwrite / reset / NDC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def box():
+    from cadence_tpu.engine.onebox import Onebox
+    b = Onebox(num_hosts=1, num_shards=4)
+    b.frontend.register_domain(DOMAIN)
+    return b
+
+
+def _current_key(box, wf):
+    domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+    run_id = box.stores.execution.get_current_run_id(domain_id, wf)
+    return (domain_id, wf, run_id)
+
+
+class TestVerifyAllResident:
+    def test_tail_overwrite_invalidates_then_reverifies(self, box):
+        """A retried-transaction tail overwrite (same event ids, new
+        bytes) changes the last batch's CRC: the pinned entry must drop
+        (counted) and the key re-verify through the full path — never
+        served from the stale resident state."""
+        import copy
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-ow", "t", TL)
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-ow", "first")
+        box.pump_once()
+        key = _current_key(box, "wf-ow")
+        assert box.tpu.verify_all().ok
+        assert box.tpu.verify_all().resident  # pinned and serving
+
+        # overwrite the tail batch in place: same ids and event types
+        # (the live state's payload is unchanged — only the BYTES moved,
+        # exactly what a retried transaction produces)
+        batches = box.stores.history.read_batches(*key)
+        tail = [copy.deepcopy(e) for e in batches[-1]]
+        for e in tail:
+            if e.event_type == EventType.WorkflowExecutionSignaled:
+                e.attrs = dict(e.attrs, signal_name="rewritten")
+        box.stores.history.append_batch(*key, tail)
+
+        reg = box.metrics
+        inval0 = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_INVALIDATIONS)
+        result = box.tpu.verify_all()
+        assert result.ok  # payload identical; bytes differ
+        assert key not in result.resident
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_CACHE_INVALIDATIONS) == inval0 + 1
+        # re-seeded from the full replay: warm again
+        assert key in box.tpu.verify_all().resident
+
+    def test_reset_stays_byte_identical(self, box):
+        """Reset rewrites the world (new run forked at the decision
+        boundary, base run terminated): every key must still verify
+        byte-identically — the resident cache may serve only what the
+        content address proves unchanged."""
+        from cadence_tpu.models.deciders import SignalDecider
+        from tests.taskpoller import TaskPoller
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-rst", "signal", TL)
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"wf-rst": SignalDecider(expected_signals=3)})
+        poller.drain()
+        key = _current_key(box, "wf-rst")
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-rst", "s-1")
+        poller.drain()
+        assert box.tpu.verify_all().ok  # pin pre-reset states
+
+        new_run = box.frontend.reset_workflow_execution(
+            DOMAIN, "wf-rst", decision_finish_event_id=4, run_id=key[2],
+            reason="resident-test")
+        result = box.tpu.verify_all()
+        assert result.ok
+        # the forked new run is a fresh key: it cannot have been served
+        # from the cache on its first verify
+        new_key = (key[0], "wf-rst", new_run)
+        assert new_key not in result.resident
+        # base run's termination append and the new run both verified;
+        # a second pass serves everything resident
+        result2 = box.tpu.verify_all()
+        assert result2.ok
+        assert len(result2.resident) == result2.total
+
+    def test_ndc_branch_switch_invalidates(self, box):
+        """An NDC branch switch (current-branch pointer moves) makes the
+        pinned single-lineage state wrong: the entry must invalidate and
+        the key route through the full tree path."""
+        box.frontend.start_workflow_execution(DOMAIN, "wf-ndc", "t", TL)
+        box.pump_once()
+        key = _current_key(box, "wf-ndc")
+        assert box.tpu.verify_all().ok
+        assert key in box.tpu.verify_all().resident
+
+        hs = box.stores.history
+        last_id = hs.read_events(*key)[-1].id
+        hs.fork_branch(*key, source_branch=0, fork_event_id=last_id)
+        hs.set_current_branch(*key, 1)
+
+        reg = box.metrics
+        inval0 = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_INVALIDATIONS)
+        result = box.tpu.verify_all()
+        assert key not in result.resident
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_CACHE_INVALIDATIONS) == inval0 + 1
+        # the live state still points at branch 0: the device's branch
+        # arbitration must surface the disagreement, not the stale cache
+        assert key in result.divergent
+
+    def test_disable_env_forces_full_path(self, box, monkeypatch):
+        from cadence_tpu.engine import resident as resident_mod
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-off", "t", TL)
+        assert box.tpu.verify_all().ok
+        monkeypatch.setenv(resident_mod.ENABLE_ENV, "0")
+        result = box.tpu.verify_all()
+        assert result.ok and not result.resident
+
+
+# ---------------------------------------------------------------------------
+# rebuilder consult
+# ---------------------------------------------------------------------------
+
+
+class TestRebuilderResident:
+    def test_rebuild_exact_then_suffix(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "wf-rb", "t", TL)
+        box.pump_once()
+        key = _current_key(box, "wf-rb")
+        assert box.tpu.verify_all().ok  # pins the state
+
+        batches = box.stores.history.as_history_batches(*key)
+        before = box.rebuilder.stats.resident
+        ms = box.rebuilder.rebuild_one(batches)
+        assert box.rebuilder.stats.resident == before + 1
+        expected = payload_row(
+            StateBuilder().replay_history(batches), DEFAULT_LAYOUT)
+        got = payload_row(ms, DEFAULT_LAYOUT)
+        got[STICKY_ROW_INDEX] = expected[STICKY_ROW_INDEX]
+        assert (got == expected).all()
+
+        # appended batch: the rebuild replays only the suffix
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-rb", "go")
+        box.pump_once()
+        batches = box.stores.history.as_history_batches(*key)
+        ms2 = box.rebuilder.rebuild_one(batches)
+        assert box.rebuilder.stats.resident == before + 2
+        assert ms2.execution_info.signal_count == 1
+        reg = box.metrics
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_RESIDENT_SUFFIX_HITS) >= 1
+
+    def test_rebuild_prefix_does_not_invalidate(self, box):
+        """Rebuild at a reset point passes a PREFIX of the stored
+        history: the lookup is non-authoritative — the pinned entry must
+        survive for the next full verify."""
+        box.frontend.start_workflow_execution(DOMAIN, "wf-pre", "t", TL)
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-pre", "x")
+        box.pump_once()
+        key = _current_key(box, "wf-pre")
+        assert box.tpu.verify_all().ok
+        batches = box.stores.history.as_history_batches(*key)
+        box.rebuilder.rebuild_one(batches[:1])  # prefix rebuild
+        assert key in box.tpu.verify_all().resident  # still pinned
+
+
+# ---------------------------------------------------------------------------
+# metrics surface
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsSurface:
+    def test_prometheus_series(self, box):
+        box.frontend.start_workflow_execution(DOMAIN, "wf-m", "t", TL)
+        assert box.tpu.verify_all().ok   # cold: miss + seed
+        box.frontend.signal_workflow_execution(DOMAIN, "wf-m", "go")
+        assert box.tpu.verify_all().ok   # suffix hit
+        assert box.tpu.verify_all().ok   # exact hit
+        text = box.metrics.to_prometheus()
+        for series in (
+            'cadence_hits_total{scope="tpu.resident"}',
+            'cadence_misses_total{scope="tpu.resident"}',
+            'cadence_suffix_hits_total{scope="tpu.resident"}',
+            'cadence_events_appended_total{scope="tpu.resident"}',
+            'cadence_resident_bytes{scope="tpu.resident"}',
+            'cadence_resident_entries{scope="tpu.resident"}',
+            'cadence_budget_bytes{scope="tpu.resident"}',
+        ):
+            assert series in text, series
+
+    def test_servicehost_preregisters_resident_series(self):
+        """A fresh host's /metrics must already expose the tpu.resident
+        names (scraped as zero before the first verify)."""
+        import urllib.request
+
+        from cadence_tpu.rpc.cluster import launch
+
+        cluster = launch(num_hosts=1, num_shards=2)
+        try:
+            (_name, port), = cluster.http_ports.items()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                text = r.read().decode()
+        finally:
+            cluster.stop()
+        assert 'cadence_invalidations_total{scope="tpu.resident"} 0' in text
+        assert 'cadence_suffix_hits_total{scope="tpu.resident"} 0' in text
+        assert 'cadence_resident_bytes{scope="tpu.resident"} 0' in text
+        assert 'cadence_budget_bytes{scope="tpu.resident"} 0' in text
+
+
+# ---------------------------------------------------------------------------
+# admin surface
+# ---------------------------------------------------------------------------
+
+
+class TestAdminResident:
+    def test_admin_resident_rollup(self, box):
+        from cadence_tpu.engine.admin import AdminHandler
+
+        box.frontend.start_workflow_execution(DOMAIN, "wf-adm", "t", TL)
+        admin = AdminHandler(box)
+        assert admin.verify().ok
+        assert admin.verify().ok
+        info = admin.resident()
+        assert info["enabled"] is True
+        assert info["entries"] == 1
+        assert info["hits"] >= 1
+        assert 0.0 < info["hit_rate"] <= 1.0
+        assert info["resident_bytes"] > 0
+        assert info["budget_bytes"] > 0
