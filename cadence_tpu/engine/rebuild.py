@@ -88,7 +88,7 @@ class DeviceRebuilder:
     """Batched device replay → full MutableState objects."""
 
     def __init__(self, layout: PayloadLayout = DEFAULT_LAYOUT,
-                 chunk_jobs: Optional[int] = None) -> None:
+                 chunk_jobs: Optional[int] = None, mesh=None) -> None:
         import os
 
         from ..utils.metrics import DEFAULT_REGISTRY
@@ -97,6 +97,15 @@ class DeviceRebuilder:
         self.stats = RebuildStats()
         self.metrics = DEFAULT_REGISTRY
         self.ladder = EscalationLadder(layout, registry=self.metrics)
+        #: serving mesh (parallel/mesh.serving_mesh knob); resolved
+        #: lazily so construction never forces JAX backend init. A
+        #: recovery/reset storm's rebuild chunks shard over the same
+        #: 'shard' axis as the verify path; the ladder's widened
+        #: re-replays ride it too (its state-keeping hydration rungs
+        #: stay single-device by design — see ladder._dense_fn)
+        self._mesh = mesh
+        if mesh is not None and int(mesh.devices.size) > 1:
+            self.ladder.mesh = mesh
         #: HBM-resident state cache to consult before full replay
         #: (Onebox wires the cluster's shared cache here — the same one
         #: TPUReplayEngine.verify_all seeds); None skips the consult
@@ -110,6 +119,15 @@ class DeviceRebuilder:
         self.chunk_jobs = (chunk_jobs if chunk_jobs else
                            int(os.environ.get("CADENCE_TPU_REBUILD_CHUNK",
                                               "2048")))
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import serving_mesh
+            self._mesh = serving_mesh()
+            if int(self._mesh.devices.size) > 1:
+                self.ladder.mesh = self._mesh
+        return self._mesh
 
     def rebuild_one(self, batches: Sequence[HistoryBatch],
                     domain_entry: Optional[DomainEntry] = None) -> MutableState:
@@ -135,7 +153,6 @@ class DeviceRebuilder:
                                if done else 0.0)
             return [self._oracle_rebuild(b, e) for b, e in jobs]
         import jax
-        import jax.numpy as jnp
 
         from ..ops.encode import encode_corpus, history_length
         from ..ops.payload import payload_rows
@@ -166,18 +183,43 @@ class DeviceRebuilder:
 
         # chunked through the shared bulk executor: a recovery storm packs
         # chunk N+1 while chunk N replays, and each chunk's event axis is
-        # sized to ITS longest history, not the whole job list's
+        # sized to ITS longest history, not the whole job list's. The
+        # chunks fan across the serving mesh (workflow axis sharded over
+        # 'shard', per-device slice copies; a mesh of 1 is single-chip)
+        from ..parallel.mesh import place_corpus
+        try:
+            mesh = self.mesh
+        except RuntimeError:
+            # serving_mesh() enumerates devices, so a MISSING BACKEND
+            # surfaces here, before the executor even runs — degrade to
+            # the oracle exactly like the executor-run handler below
+            # (the CLI-on-a-deviceless-host contract, ADVICE r3)
+            self.stats.oracle_fallback += len(jobs)
+            scope.inc(m.M_ORACLE_FALLBACKS, len(jobs))
+            return self._merge_prepass(
+                pre, positions,
+                [self._oracle_rebuild(b, e) for b, e in jobs])
+        n_dev = int(mesh.devices.size)
         chunk_jobs = max(1, self.chunk_jobs)
         spans = [(lo, min(lo + chunk_jobs, len(jobs)))
                  for lo in range(0, len(jobs), chunk_jobs)]
         executor = BulkReplayExecutor(registry=self.metrics,
-                                      scope=m.SCOPE_REBUILD)
+                                      scope=m.SCOPE_REBUILD, mesh=mesh)
 
         def pack(ci):
             lo, hi = spans[ci]
             chunk = jobs[lo:hi]
             max_events = max(history_length(b) for b, _ in chunk)
             corpus = encode_corpus([b for b, _ in chunk], max_events)
+            if corpus.shape[0] % n_dev:
+                # whole slice per device: pad with no-op rows
+                from ..ops.encode import LANE_EVENT_TYPE, NUM_LANES
+                pad_w = -(-corpus.shape[0] // n_dev) * n_dev \
+                    - corpus.shape[0]
+                pad = np.zeros((pad_w, corpus.shape[1], NUM_LANES),
+                               dtype=np.int64)
+                pad[:, :, LANE_EVENT_TYPE] = -1
+                corpus = np.concatenate([corpus, pad])
             return corpus, sum(history_length(b) for b, _ in chunk)
 
         def launch(ci, packed):
@@ -185,7 +227,7 @@ class DeviceRebuilder:
             scope.inc(m.M_KERNEL_LAUNCHES)
             scope.inc(m.M_EVENTS_REPLAYED, chunk_events)
             with prof.leg(m.M_PROFILE_H2D):
-                device_corpus = jax.device_put(jnp.asarray(corpus))
+                device_corpus = place_corpus(corpus, mesh)
                 prof.h2d(corpus.nbytes)
             state, _log = replay_events_with_tasks(device_corpus,
                                                    self.layout)

@@ -29,7 +29,15 @@ ResidentStateCache is the device twin of that execution cache:
   just the suffix (engine/ladder.escalate_resident); resolved rows
   remain resident at the widened layout and re-narrow to base once
   their pending load drains (ops/state.narrow_ok) — the widen/re-narrow
-  round trip that keeps escalated rows out of the full-replay path.
+  round trip that keeps escalated rows out of the full-replay path;
+- under a serving mesh (set_mesh) the pool SHARDS across the devices:
+  each workflow's pinned state lives on the device its key hashes to
+  (parallel/mesh.workflow_shard — the same stable key→shard assignment
+  the mesh-aware executor lays chunks out by), the HBM budget splits
+  into equal per-device slices with per-device LRU eviction, and append
+  replays group by owning device so the from-state launch — and any
+  ladder widen/re-narrow it escalates into — runs on the device already
+  holding the state, never dragging a resident row across the mesh.
 
 Correctness gate: the mutable-state checksum is the oracle, same as
 always — resident incremental replay must produce byte-identical
@@ -138,7 +146,8 @@ class ResidentStateCache:
                  budget_bytes: Optional[int] = None,
                  registry=None, ladder=None,
                  chunk_workflows: Optional[int] = None,
-                 pipeline_depth: Optional[int] = None) -> None:
+                 pipeline_depth: Optional[int] = None,
+                 mesh=None) -> None:
         self.layout = layout
         self.budget_bytes = (budget_bytes if budget_bytes is not None
                              else int(os.environ.get(BUDGET_ENV,
@@ -153,12 +162,71 @@ class ResidentStateCache:
                                                         str(DEFAULT_CHUNK))))
         self.pipeline_depth = pipeline_depth
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, ResidentEntry]" = OrderedDict()
-        self._bytes = 0
+        #: serving mesh (None = unsharded single-device pool); entries
+        #: live per shard slice — OrderedDict per mesh position, each
+        #: with its own byte count and LRU order
+        self._mesh = mesh
+        n = int(mesh.devices.size) if mesh is not None else 1
+        self._slices: List["OrderedDict[tuple, ResidentEntry]"] = [
+            OrderedDict() for _ in range(n)]
+        self._slice_bytes: List[int] = [0] * n
         self._row_bytes_cache: Dict[PayloadLayout, int] = {}
         self.last_append = AppendReport()
         _LIVE.add(self)
         self._gauges()
+
+    # -- mesh sharding ------------------------------------------------------
+
+    def set_mesh(self, mesh) -> None:
+        """(Re)bind the pool to a serving mesh: per-device slices keyed
+        by workflow_shard, HBM budget split per device. Rebinding to a
+        different width — or to the SAME width over different/permuted
+        devices — drops every entry: states pinned under the old
+        key→device assignment would otherwise serve from (and widen on)
+        the wrong device, handing one jit inputs committed to two
+        devices. An unsharded pool (width 1) never pins placement, so
+        device identity is irrelevant there."""
+        n = int(mesh.devices.size) if mesh is not None else 1
+        new_devs = (tuple(mesh.devices.flat)
+                    if mesh is not None and n > 1 else ())
+        with self._lock:
+            old_n = len(self._slices)
+            old_devs = (tuple(self._mesh.devices.flat)
+                        if self._mesh is not None and old_n > 1 else ())
+            self._mesh = mesh
+            if n == old_n and new_devs == old_devs:
+                return
+            # zero the outgoing width's per-device gauges BEFORE the
+            # slices shrink: a dashboard keyed on resident-bytes-dev{d}
+            # must not keep reporting phantom occupancy
+            if old_n > 1:
+                for d in range(old_n):
+                    self.metrics.gauge(
+                        m.SCOPE_TPU_RESIDENT,
+                        m.device_metric(m.M_RESIDENT_BYTES, d), 0.0)
+            self._slices = [OrderedDict() for _ in range(n)]
+            self._slice_bytes = [0] * n
+            self._gauges_locked()
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._slices)
+
+    def shard_of(self, key: tuple) -> int:
+        from ..parallel.mesh import workflow_shard
+        return workflow_shard(key, len(self._slices))
+
+    def device_of(self, key: tuple):
+        """The mesh device owning this key's resident slice (None when
+        the pool is unsharded — placement is wherever the state already
+        lives, today's single-device behavior)."""
+        if self._mesh is None or len(self._slices) <= 1:
+            return None
+        return self._mesh.devices.flat[self.shard_of(key)]
+
+    @property
+    def slice_budget(self) -> int:
+        return max(1, self.budget_bytes // len(self._slices))
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -166,12 +234,22 @@ class ResidentStateCache:
         return self.metrics.scope(m.SCOPE_TPU_RESIDENT)
 
     def _gauges(self) -> None:
+        self._gauges_locked()
+
+    def _gauges_locked(self) -> None:
         self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_BYTES,
-                           float(self._bytes))
+                           float(sum(self._slice_bytes)))
         self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_ENTRIES,
-                           float(len(self._entries)))
+                           float(sum(len(s) for s in self._slices)))
         self.metrics.gauge(m.SCOPE_TPU_RESIDENT, m.M_RESIDENT_BUDGET_BYTES,
                            float(self.budget_bytes))
+        if len(self._slices) > 1:
+            # per-device occupancy of the sharded pool, next to the
+            # executor's per-device series
+            for d, nbytes in enumerate(self._slice_bytes):
+                self.metrics.gauge(
+                    m.SCOPE_TPU_RESIDENT,
+                    m.device_metric(m.M_RESIDENT_BYTES, d), float(nbytes))
 
     def _row_nbytes(self, layout: PayloadLayout) -> int:
         """HBM bytes of one W=1 state row at `layout` (+ the host payload
@@ -190,12 +268,12 @@ class ResidentStateCache:
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._entries)
+            return sum(len(s) for s in self._slices)
 
     @property
     def resident_bytes(self) -> int:
         with self._lock:
-            return self._bytes
+            return sum(self._slice_bytes)
 
     def stats(self) -> Dict[str, object]:
         """Occupancy / hit-rate / budget rollup (the `admin resident`
@@ -206,13 +284,17 @@ class ResidentStateCache:
         misses = reg.counter(m.SCOPE_TPU_RESIDENT, m.M_CACHE_MISSES)
         looked = hits + suffix + misses
         with self._lock:
-            entries = len(self._entries)
-            resident = self._bytes
-            widened = sum(1 for e in self._entries.values() if e.rung > 0)
+            entries = sum(len(s) for s in self._slices)
+            resident = sum(self._slice_bytes)
+            widened = sum(1 for s in self._slices
+                          for e in s.values() if e.rung > 0)
+            per_device = list(self._slice_bytes)
         return {
             "entries": entries,
             "widened_entries": widened,
             "resident_bytes": resident,
+            "mesh_shards": len(per_device),
+            "per_device_bytes": per_device,
             "budget_bytes": self.budget_bytes,
             "budget_used": (resident / self.budget_bytes
                             if self.budget_bytes else 0.0),
@@ -243,9 +325,10 @@ class ResidentStateCache:
         entry stays, the call just misses."""
         scope = self._scope()
         with self._lock:
-            entry = self._entries.get(key)
+            sl = self._slices[self.shard_of(key)]
+            entry = sl.get(key)
             if entry is not None:
-                self._entries.move_to_end(key)
+                sl.move_to_end(key)
         if entry is not None:
             relation = address_relation(entry.address, batches)
             if relation == "exact":
@@ -264,47 +347,59 @@ class ResidentStateCache:
         branch-switch seam — callers that detect a non-append mutation
         call this, and lookup() calls it itself on address mismatch."""
         with self._lock:
-            entry = self._entries.pop(key, None)
+            shard = self.shard_of(key)
+            entry = self._slices[shard].pop(key, None)
             if entry is not None:
-                self._bytes -= entry.nbytes
-            self._gauges()
+                self._slice_bytes[shard] -= entry.nbytes
+            self._gauges_locked()
         if entry is not None:
             self._scope().inc(m.M_CACHE_INVALIDATIONS)
         return entry is not None
 
     def clear(self) -> None:
         with self._lock:
-            self._entries.clear()
-            self._bytes = 0
-            self._gauges()
+            for sl in self._slices:
+                sl.clear()
+            self._slice_bytes = [0] * len(self._slices)
+            self._gauges_locked()
 
     def admit(self, key: tuple, address: ContentAddress, state_row,
               payload: np.ndarray, branch: int, rung: int = 0) -> bool:
-        """Pin one workflow's W=1 state row; LRU-evicts past the HBM
-        budget. `state_row` must already be a W=1 slice (extract_row).
-        Returns False when the row alone exceeds the budget (never
-        admitted — a budget of 0 disables residency entirely)."""
+        """Pin one workflow's W=1 state row; LRU-evicts past the owning
+        device's slice of the HBM budget. `state_row` must already be a
+        W=1 slice (extract_row); under a sharded pool it is PLACED on
+        the key's owning device before pinning, so every later suffix
+        replay / ladder widen of this row runs there. Returns False when
+        the row alone exceeds the slice budget (never admitted — a
+        budget of 0 disables residency entirely)."""
         from ..ops.state import layout_of
 
         nbytes = self._row_nbytes(layout_of(state_row))
-        if nbytes > self.budget_bytes:
+        if nbytes > self.slice_budget or nbytes > self.budget_bytes:
             return False
+        device = self.device_of(key)
+        if device is not None:
+            import jax
+            state_row = jax.device_put(state_row, device)
         entry = ResidentEntry(state=state_row,
                               payload=np.asarray(payload, dtype=np.int64),
                               branch=int(branch), address=address,
                               rung=int(rung), nbytes=nbytes)
         evicted = 0
         with self._lock:
-            old = self._entries.pop(key, None)
+            shard = self.shard_of(key)
+            sl = self._slices[shard]
+            old = sl.pop(key, None)
             if old is not None:
-                self._bytes -= old.nbytes
-            self._entries[key] = entry
-            self._bytes += nbytes
-            while self._bytes > self.budget_bytes and len(self._entries) > 1:
-                _, dropped = self._entries.popitem(last=False)
-                self._bytes -= dropped.nbytes
+                self._slice_bytes[shard] -= old.nbytes
+            sl[key] = entry
+            self._slice_bytes[shard] += nbytes
+            while self._slice_bytes[shard] > self.slice_budget \
+                    and len(sl) > 1:
+                _, dropped = sl.popitem(last=False)
+                self._slice_bytes[shard] -= dropped.nbytes
                 evicted += 1
-            self._gauges()
+            self._gauges_locked()
         if evicted:
             self.metrics.inc(m.SCOPE_TPU_RESIDENT, m.M_CACHE_EVICTIONS,
                              evicted)
@@ -354,16 +449,23 @@ class ResidentStateCache:
             encode_suffix = _encode_suffix_cold
         results: List[Optional[AppendResult]] = [None] * len(items)
         self.last_append = AppendReport(transactions=len(items))
-        by_rung: Dict[int, List[int]] = {}
-        for i, (_key, entry, _batches) in enumerate(items):
-            by_rung.setdefault(entry.rung, []).append(i)
-        for rung, idxs in sorted(by_rung.items()):
-            self._append_group(items, idxs, rung, encode_suffix, results)
+        # group by (rung, owning shard): states in one launch must share
+        # a layout, and under a sharded pool the from-state replay (plus
+        # any ladder widen it escalates into) runs on the device that
+        # already holds the group's states
+        by_group: Dict[tuple, List[int]] = {}
+        for i, (key, entry, _batches) in enumerate(items):
+            by_group.setdefault((entry.rung, self.shard_of(key)),
+                                []).append(i)
+        for (rung, shard), idxs in sorted(by_group.items()):
+            self._append_group(items, idxs, rung, encode_suffix, results,
+                               shard=shard)
         return [r if r is not None else AppendResult(ok=False)
                 for r in results]
 
     def _append_group(self, items, idxs: List[int], rung: int,
-                      encode_suffix, results: List) -> None:
+                      encode_suffix, results: List,
+                      shard: int = 0) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -398,19 +500,31 @@ class ResidentStateCache:
                 corpus = np.concatenate([corpus, pad])
             return corpus
 
+        device = (self._mesh.devices.flat[shard]
+                  if self._mesh is not None and len(self._slices) > 1
+                  else None)
+
         def launch(ci, corpus):
             lo, hi = spans[ci]
             states = [items[i][1].state for i in idxs[lo:hi]]
             if corpus.shape[0] > len(states):
-                states.append(init_state(corpus.shape[0] - len(states),
-                                         layout_g))
+                pad_rows = init_state(corpus.shape[0] - len(states),
+                                      layout_g)
+                if device is not None:
+                    pad_rows = jax.device_put(pad_rows, device)
+                states.append(pad_rows)
             s0 = self._stack_rows(states) if len(states) > 1 else states[0]
             self.last_append.chunk_shapes.append(
                 (corpus.shape[0], corpus.shape[1]))
             events = int((corpus[:, :, 0] > 0).sum())  # LANE_EVENT_ID
             self.last_append.events_appended += events
             scope.inc(m.M_RESIDENT_EVENTS_APPENDED, events)
-            corpus_dev = jax.device_put(jnp.asarray(corpus))
+            # the suffix lanes ship to the OWNING device: the group's
+            # resident states already live there, so the whole
+            # from-state append is device-local
+            corpus_dev = (jax.device_put(corpus, device)
+                          if device is not None
+                          else jax.device_put(jnp.asarray(corpus)))
             outs = replay_from_state_to_payload(corpus_dev, s0, self.layout)
             return corpus, outs
 

@@ -40,7 +40,6 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..core.checksum import (
@@ -104,13 +103,30 @@ class BulkVerifyResult:
         return not self.divergent
 
 
+@dataclass
+class _ChunkPlan:
+    """One chunk of the mesh-aware serving run: which keys it carries
+    (global indices into the run's key list), which corpus ROW each key
+    occupies, and the padded workflow axis. On a mesh of 1 rows are the
+    contiguous prefix (today's layout, byte for byte); on a mesh of N
+    the chunk is N per-shard slices of P rows each — key k sits in slice
+    workflow_shard(k, N), so sharded placement lands every workflow on
+    its owning device and the resident pool stays device-local."""
+
+    idx: List[int]
+    rows: np.ndarray
+    W: int
+
+
 class TPUReplayEngine:
-    """Bulk device replay over persisted histories."""
+    """Bulk device replay over persisted histories, served from the
+    device mesh (mesh of 1 = the single-chip configuration)."""
 
     def __init__(self, stores: Stores,
                  layout: PayloadLayout = DEFAULT_LAYOUT,
                  chunk_workflows: Optional[int] = None,
-                 pipeline_depth: Optional[int] = None) -> None:
+                 pipeline_depth: Optional[int] = None,
+                 mesh=None) -> None:
         self.stores = stores
         self.layout = layout
         self.pack_cache = PackCache()
@@ -118,7 +134,8 @@ class TPUReplayEngine:
         #: HBM-resident per-workflow states: verify_all serves unchanged
         #: workflows from the cache and replays only appended batches for
         #: suffix hits; full replay remains the cold-miss and
-        #: parity-audit path (engine/resident.py)
+        #: parity-audit path (engine/resident.py). Sharded across the
+        #: mesh with the engine (per-device slices, split budget).
         self.resident = ResidentStateCache(layout, ladder=self.ladder,
                                            pipeline_depth=pipeline_depth)
         self.metrics = m.DEFAULT_REGISTRY
@@ -126,10 +143,37 @@ class TPUReplayEngine:
                                 else int(os.environ.get(CHUNK_ENV,
                                                         str(DEFAULT_CHUNK))))
         self.pipeline_depth = pipeline_depth
+        #: serving mesh (parallel/mesh.serving_mesh resolves the
+        #: CADENCE_TPU_MESH_DEVICES knob); resolved LAZILY so engine
+        #: construction never forces JAX backend init
+        self._mesh = mesh
+        if mesh is not None:
+            self._wire_mesh(mesh)
         #: (W, E) of each chunk of the last bulk run — the test seam for
         #: the bounded-footprint contract (a long-tail history inflates
         #: only its own chunk's E)
         self.last_run_chunk_shapes: List[Tuple[int, int]] = []
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import serving_mesh
+            self._mesh = serving_mesh()
+            self._wire_mesh(self._mesh)
+        return self._mesh
+
+    def _wire_mesh(self, mesh) -> None:
+        """One mesh through every layer: the escalation ladder re-replays
+        flagged rows under the same 'shard' axis (the already-sharded
+        replay_sharded_escalated kernels) and the resident pool splits
+        its HBM budget into per-device slices."""
+        if int(mesh.devices.size) > 1:
+            self.ladder.mesh = mesh
+        self.resident.set_mesh(mesh)
+
+    @property
+    def mesh_size(self) -> int:
+        return int(self.mesh.devices.size)
 
     @property
     def metrics(self):
@@ -204,56 +248,111 @@ class TPUReplayEngine:
         c = max(1, self.chunk_workflows)
         return [(lo, min(lo + c, n)) for lo in range(0, n, c)]
 
-    def _pack_chunk(self, keys: Sequence[Tuple[str, str, str]],
-                    pad_to: int) -> np.ndarray:
-        """Encode one chunk of keys into [pad_to, E, L]; E is the pow2
-        bucket of THIS chunk's longest history, not the corpus-wide max —
-        the bounded-memory contract. Pad workflows are all-padding rows
-        (the kernel no-ops them)."""
-        rows_list = [self._encode_key_rows(k) for k in keys]
+    def _plan_chunks(self, keys: List[Tuple[str, str, str]]
+                     ) -> List[_ChunkPlan]:
+        """Chunk the key list for the mesh. Mesh of 1: contiguous spans
+        padded to the run-constant width — exactly the pre-mesh layout.
+        Mesh of N: keys bucket by workflow_shard (the stable key→device
+        hash mirroring numHistoryShards→host), each chunk takes up to P
+        keys of EVERY bucket so row s*P+i belongs to shard s and sharded
+        placement puts each workflow on its owning device."""
+        n = self.mesh_size
+        if n <= 1:
+            pad_to = min(max(1, self.chunk_workflows), len(keys))
+            return [_ChunkPlan(idx=list(range(lo, hi)),
+                               rows=np.arange(hi - lo), W=pad_to)
+                    for lo, hi in self._chunk_spans(len(keys))]
+        from ..parallel.mesh import workflow_shard
+        buckets: List[List[int]] = [[] for _ in range(n)]
+        for i, key in enumerate(keys):
+            buckets[workflow_shard(key, n)].append(i)
+        per = max(1, -(-self.chunk_workflows // n))
+        P = min(per, max((len(b) for b in buckets), default=1))
+        plans: List[_ChunkPlan] = []
+        off = 0
+        while any(len(b) > off for b in buckets):
+            idx: List[int] = []
+            rows: List[int] = []
+            for s, b in enumerate(buckets):
+                sl = b[off:off + P]
+                idx.extend(sl)
+                rows.extend(s * P + j for j in range(len(sl)))
+            plans.append(_ChunkPlan(idx=idx, rows=np.asarray(rows,
+                                                             dtype=np.int64),
+                                    W=n * P))
+            off += P
+        return plans
+
+    def _pack_chunk(self, chunk_keys: Sequence[Tuple[str, str, str]],
+                    rows: np.ndarray, pad_to: int) -> np.ndarray:
+        """Encode one chunk of keys into [pad_to, E, L], key j landing
+        on corpus row rows[j] (its shard's slice); E is the pow2 bucket
+        of THIS chunk's longest history, not the corpus-wide max — the
+        bounded-memory contract. All other rows are padding (the kernel
+        no-ops them)."""
+        rows_list = [self._encode_key_rows(k) for k in chunk_keys]
         E = _bucket_events(max((r.shape[0] for r in rows_list), default=1))
-        corpus = assemble_corpus(rows_list, E)
-        if corpus.shape[0] < pad_to:
-            pad = np.zeros((pad_to - corpus.shape[0], E, NUM_LANES),
-                           dtype=np.int64)
-            pad[:, :, LANE_EVENT_TYPE] = -1
-            corpus = np.concatenate([corpus, pad])
+        sub = assemble_corpus(rows_list, E)
+        corpus = np.zeros((pad_to, E, NUM_LANES), dtype=np.int64)
+        corpus[:, :, LANE_EVENT_TYPE] = -1
+        corpus[np.asarray(rows)] = sub
         return corpus
 
     def _run_chunks(self, keys: List[Tuple[str, str, str]], pack_extra,
-                    launch_fn, readback_fn, escalate_fn=None):
-        """Drive the pipelined executor over key chunks.
+                    launch_fn, readback_fn, escalate_fn=None, plans=None):
+        """Drive the pipelined executor over key chunks, fanned across
+        the serving mesh (per-device H2D slice copies; a mesh of 1 is
+        the single-chip configuration, byte for byte).
 
-        pack_extra(chunk_keys) -> host-side extras packed alongside the
-        corpus (runs in the pack pool, overlapped with device compute);
+        pack_extra(chunk_keys, plan) -> host-side extras packed
+        alongside the corpus (runs in the pack pool, overlapped with
+        device compute; extras sized [plan.W, ...] in ROW space);
         launch_fn(corpus_dev, extras) -> device outs (async);
-        readback_fn(outs) -> numpy results per chunk;
+        readback_fn(outs) -> numpy results per chunk (row space);
         escalate_fn(ci, corpus_np, consumed) -> consumed — optional
         capacity-escalation seam: called right after chunk ci's readback
         with its HOST corpus (held only until then — at most `depth`
         corpora are ever retained, the ring bound), so flagged rows can
         gather and dispatch widened re-replays while later chunks still
         pack and replay.
-        Returns (per-chunk results, per-chunk real-event counts)."""
-        spans = self._chunk_spans(len(keys))
-        pad_to = min(max(1, self.chunk_workflows), len(keys))
+        Returns (per-chunk results, per-chunk plans)."""
+        from ..parallel.mesh import place_corpus
+
+        if plans is None:
+            plans = self._plan_chunks(keys)
+        mesh = self.mesh
         prof = ReplayProfiler(self.metrics)
         scope = self.metrics.scope(m.SCOPE_TPU_REPLAY)
         executor = BulkReplayExecutor(depth=self.pipeline_depth,
-                                      registry=self.metrics)
-        shapes: List[Optional[Tuple[int, int]]] = [None] * len(spans)
-        events: List[int] = [0] * len(spans)
+                                      registry=self.metrics, mesh=mesh)
+        shapes: List[Optional[Tuple[int, int]]] = [None] * len(plans)
+        events: List[int] = [0] * len(plans)
         corpora: dict = {}
 
+        n_dev = int(mesh.devices.size)
+
         def pack(ci):
-            lo, hi = spans[ci]
-            chunk_keys = keys[lo:hi]
-            corpus = self._pack_chunk(chunk_keys, pad_to)
+            plan = plans[ci]
+            chunk_keys = [keys[i] for i in plan.idx]
+            corpus = self._pack_chunk(chunk_keys, plan.rows, plan.W)
             shapes[ci] = (corpus.shape[0], corpus.shape[1])
             events[ci] = int((corpus[:, :, LANE_EVENT_ID] > 0).sum())
+            if n_dev > 1:
+                # per-device real-row counters (shard-population skew is
+                # a scrape away: tpu.executor/rows-dispatched-dev{d}),
+                # scanned in the overlapped pack pool, off the serial
+                # dispatch path
+                exec_scope = self.metrics.scope(m.SCOPE_TPU_EXECUTOR)
+                slice_w = corpus.shape[0] // n_dev
+                for d in range(n_dev):
+                    rows_d = int((corpus[d * slice_w:(d + 1) * slice_w,
+                                         :, LANE_EVENT_ID] > 0)
+                                 .any(axis=1).sum())
+                    exec_scope.inc(m.device_metric(m.M_EXEC_ROWS, d),
+                                   rows_d)
             if escalate_fn is not None:
                 corpora[ci] = corpus
-            extras = pack_extra(chunk_keys) if pack_extra else None
+            extras = pack_extra(chunk_keys, plan) if pack_extra else None
             return corpus, extras
 
         def launch(ci, packed):
@@ -261,7 +360,7 @@ class TPUReplayEngine:
             scope.inc(m.M_KERNEL_LAUNCHES)
             scope.inc(m.M_EVENTS_REPLAYED, events[ci])
             with prof.leg(m.M_PROFILE_H2D):
-                corpus_dev = jax.device_put(jnp.asarray(corpus))
+                corpus_dev = place_corpus(corpus, mesh)
                 prof.h2d(corpus.nbytes)
             return launch_fn(corpus_dev, extras)
 
@@ -276,7 +375,7 @@ class TPUReplayEngine:
 
         with scope.timed():
             results, _report = executor.run(
-                len(spans), pack, launch, consume,
+                len(plans), pack, launch, consume,
                 escalate if escalate_fn is not None else None)
         self.last_run_chunk_shapes = [s for s in shapes if s is not None]
         t = self.metrics.timer(m.SCOPE_TPU_REPLAY, m.M_LATENCY)
@@ -285,7 +384,7 @@ class TPUReplayEngine:
                 m.SCOPE_TPU_REPLAY, m.M_REPLAY_THROUGHPUT,
                 self.metrics.counter(m.SCOPE_TPU_REPLAY, m.M_EVENTS_REPLAYED)
                 / t.total_s)
-        return results, spans
+        return results, plans
 
     def replay_tree_payloads(self, keys: Sequence[Tuple[str, str, str]]
                              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -314,13 +413,14 @@ class TPUReplayEngine:
             return (np.asarray(rows_dev), np.asarray(err_dev),
                     np.asarray(branch_dev))
 
-        results, spans = self._run_chunks(keys, None, launch, readback)
-        rows = np.concatenate([r[0][:hi - lo]
-                               for r, (lo, hi) in zip(results, spans)])
-        errors = np.concatenate([r[1][:hi - lo]
-                                 for r, (lo, hi) in zip(results, spans)])
-        branch = np.concatenate([r[2][:hi - lo]
-                                 for r, (lo, hi) in zip(results, spans)])
+        results, plans = self._run_chunks(keys, None, launch, readback)
+        rows = np.zeros((len(keys), self.layout.width), dtype=np.int64)
+        errors = np.zeros((len(keys),), dtype=np.int32)
+        branch = np.zeros((len(keys),), dtype=np.int32)
+        for plan, (r, e, b) in zip(plans, results):
+            rows[plan.idx] = r[plan.rows]
+            errors[plan.idx] = e[plan.rows]
+            branch[plan.idx] = b[plan.rows]
         return rows, errors, branch
 
     def _expected_row(self, key: Tuple[str, str, str]
@@ -391,6 +491,10 @@ class TPUReplayEngine:
         all_keys = list(keys)
         if not all_keys:
             return BulkVerifyResult(total=0, verified_on_device=0)
+        # resolve (and wire) the serving mesh BEFORE the resident
+        # partition: the pool's shard structure must be bound before any
+        # lookup/admit decides which device slice a key belongs to
+        self.mesh
         result = BulkVerifyResult(total=len(all_keys), verified_on_device=0)
         if resident_mod.enabled():
             exact, suffix, keys, addresses = \
@@ -429,42 +533,38 @@ class TPUReplayEngine:
 
         if not keys:
             return result
-        spans = self._chunk_spans(len(keys))
-        #: ci -> (capacity-flagged local indices, pending rung-1 dispatch)
+        from ..parallel.mesh import place_corpus
+        mesh = self.mesh
+        #: ci -> (capacity-flagged local key indices, pending rung-1
+        #: dispatch)
         pending: dict = {}
 
-        def pack_extra(chunk_keys):
-            expected = np.zeros((len(chunk_keys), self.layout.width),
+        def pack_extra(chunk_keys, plan):
+            # expected rows live in ROW space ([plan.W, ...]), scattered
+            # to each key's shard slice so the on-device compare stays
+            # local to the owning device; padding rows' entries are
+            # zero-filled garbage the result loop never reads
+            expected = np.zeros((plan.W, self.layout.width),
                                 dtype=np.int64)
-            exp_branch = np.zeros((len(chunk_keys),), dtype=np.int32)
+            exp_branch = np.zeros((plan.W,), dtype=np.int32)
             for j, key in enumerate(chunk_keys):
                 live_ms = self.stores.execution.get_workflow(*key)
                 row = payload_row(live_ms, self.layout)
                 # sticky state is active-side only; replay clears it
                 # (STICKY_ROW_INDEX note in core/checksum.py)
                 row[STICKY_ROW_INDEX] = 0
-                expected[j] = row
-                exp_branch[j] = live_ms.version_histories.current_index
+                expected[plan.rows[j]] = row
+                exp_branch[plan.rows[j]] = \
+                    live_ms.version_histories.current_index
             return expected, exp_branch
 
         def launch(corpus_dev, extras):
             expected, exp_branch = extras
-            W = int(corpus_dev.shape[0])
-            if W > expected.shape[0]:
-                # tail-chunk padding workflows: their bitmap entries are
-                # garbage but the result loop never reads past the real
-                # key count, so zero-filled expectations are fine
-                expected = np.concatenate([
-                    expected, np.zeros((W - expected.shape[0],
-                                        expected.shape[1]), np.int64)])
-                exp_branch = np.concatenate([
-                    exp_branch, np.zeros((W - exp_branch.shape[0],),
-                                         np.int32)])
             state = replay_events(corpus_dev, self.layout)
             rows_dev = payload_rows(state, self.layout)
-            mismatch = verify_rows(rows_dev, jnp.asarray(expected),
+            mismatch = verify_rows(rows_dev, place_corpus(expected, mesh),
                                    state.current_branch,
-                                   jnp.asarray(exp_branch))
+                                   place_corpus(exp_branch, mesh))
             return mismatch, state.error, expected, exp_branch, state
 
         def readback(outs):
@@ -474,27 +574,35 @@ class TPUReplayEngine:
 
         def escalate(ci, corpus, consumed):
             mismatch, errors, expected, exp_branch, state = consumed
-            lo, hi = spans[ci]
-            cap = self.ladder.capacity_flagged(errors[:hi - lo])
-            if len(cap):
-                pending[ci] = (cap, self.ladder.submit(
-                    gather_subcorpus(corpus, cap)))
+            plan = plans_by_ci[ci]
+            # errors come back in row space; flag capacity overflow on
+            # REAL rows only and remember the flagged keys' positions
+            cap_local = self.ladder.capacity_flagged(errors[plan.rows])
+            if len(cap_local):
+                cap_rows = np.asarray(plan.rows)[cap_local]
+                pending[ci] = (cap_local, self.ladder.submit(
+                    gather_subcorpus(corpus, cap_rows)))
             # seed the resident cache from this chunk's verified-clean
             # rows: the device row equals the shipped expected row
             # whenever the mismatch bit is clear, so admission costs one
-            # state-row slice per key and zero extra readback. The state
+            # state-row slice per key and zero extra readback (the cache
+            # re-places the row on the key's owning device). The state
             # reference is dropped here (the ring keeps O(depth) alive).
-            for j, key in enumerate(keys[lo:hi]):
-                if (errors[j] == 0 and not mismatch[j]
+            for j, i in enumerate(plan.idx):
+                key = keys[i]
+                r = int(plan.rows[j])
+                if (errors[r] == 0 and not mismatch[r]
                         and key in addresses):
                     self.resident.admit(
                         key, addresses[key],
-                        self.resident.extract_row(state, j),
-                        expected[j], int(exp_branch[j]))
+                        self.resident.extract_row(state, r),
+                        expected[r], int(exp_branch[r]))
             return mismatch, errors, expected, exp_branch
 
-        results, spans = self._run_chunks(keys, pack_extra, launch,
-                                          readback, escalate)
+        plans_by_ci = self._plan_chunks(keys)
+        results, plans = self._run_chunks(keys, pack_extra, launch,
+                                          readback, escalate,
+                                          plans=plans_by_ci)
         ordered = sorted(pending.items())
         outcomes = self.ladder.finish([p for _, (_, p) in ordered])
         resolved = {}  # (ci, local j) -> (base-width ladder row, branch)
@@ -504,10 +612,12 @@ class TPUReplayEngine:
                     resolved[(ci, int(j))] = (outcome.rows[k],
                                               outcome.branch[k])
 
-        for ci, ((lo, hi), (mismatch, errors, expected, exp_branch)
-                 ) in enumerate(zip(spans, results)):
-            for j, key in enumerate(keys[lo:hi]):
-                if errors[j] != 0 and (ci, j) in resolved:
+        for ci, (plan, (mismatch, errors, expected, exp_branch)
+                 ) in enumerate(zip(plans, results)):
+            for j, i in enumerate(plan.idx):
+                key = keys[i]
+                r = int(plan.rows[j])
+                if errors[r] != 0 and (ci, j) in resolved:
                     # the widened-K re-replay cleared the capacity flag:
                     # this row verified on device, no oracle involved.
                     # Same contract as verify_rows: payload rows AND the
@@ -515,21 +625,21 @@ class TPUReplayEngine:
                     result.verified_on_device += 1
                     result.escalated.append(key)
                     rows_l, branch_l = resolved[(ci, j)]
-                    if (not (rows_l == expected[j]).all()
-                            or branch_l != exp_branch[j]):
+                    if (not (rows_l == expected[r]).all()
+                            or branch_l != exp_branch[r]):
                         result.divergent.append(key)
-                elif errors[j] != 0:
+                elif errors[r] != 0:
                     # top-rung overflow or a non-capacity error: the
                     # per-workflow oracle arbitrates, as before
-                    result.device_errors.append((key, int(errors[j])))
+                    result.device_errors.append((key, int(errors[r])))
                     result.fallback.append(key)
                     oracle_ms = StateBuilder().replay_history(
                         self.stores.history.as_history_batches(*key))
                     if not (payload_row(oracle_ms, self.layout)
-                            == expected[j]).all():
+                            == expected[r]).all():
                         result.divergent.append(key)
                 else:
                     result.verified_on_device += 1
-                    if mismatch[j]:
+                    if mismatch[r]:
                         result.divergent.append(key)
         return result

@@ -67,6 +67,26 @@ class FeedReport:
 _EMPTY_BLOB = b"\x00\x00\x00\x00"
 
 
+def _resolve_mesh(mesh):
+    """Serving-mesh resolution for the ingest pipeline: an explicit mesh
+    wins; otherwise the CADENCE_TPU_MESH_DEVICES knob decides — unset
+    (the default 1) keeps the exact single-device placement path, any
+    other value shards every chunk over the mesh's 'shard' axis with
+    per-device slice copies."""
+    if mesh is not None:
+        return mesh
+    from ..parallel.mesh import mesh_devices_requested, serving_mesh
+    return serving_mesh() if mesh_devices_requested() != 1 else None
+
+
+def _mesh_chunk(chunk_workflows: int, mesh) -> int:
+    """Round the chunk width up to a whole slice per device."""
+    if mesh is None:
+        return chunk_workflows
+    n = int(mesh.devices.size)
+    return -(-chunk_workflows // n) * n
+
+
 def _chunk_blobs(blobs: Sequence[bytes], lo: int,
                  chunk_workflows: int) -> List[bytes]:
     chunk = list(blobs[lo:lo + chunk_workflows])
@@ -79,17 +99,21 @@ def _chunk_blobs(blobs: Sequence[bytes], lo: int,
 def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
           layout: PayloadLayout, num_threads: Optional[int],
           num_lanes: int, dtype, pack_fn, replay_fn,
-          depth: Optional[int] = None
+          depth: Optional[int] = None, mesh=None
           ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """The pipelined feed loop, shared by the int64 and wire32 formats,
     on the bulk executor: ring of `depth` pack buffers, pack pool runs
     ahead of the device, a buffer is reused only after the chunk that
     last used it has fully replayed (the depth-2 buffer-reuse race fix
-    of VERDICT r3 weak #1, generalized)."""
+    of VERDICT r3 weak #1, generalized). Under a serving mesh each
+    chunk's workflow axis shards over 'shard' with per-device slice
+    copies — the ingest pipeline feeds N devices from one host."""
     import jax
 
+    mesh = _resolve_mesh(mesh)
+    chunk_workflows = _mesh_chunk(chunk_workflows, mesh)
     total = len(blobs)
-    executor = BulkReplayExecutor(depth=depth)
+    executor = BulkReplayExecutor(depth=depth, mesh=mesh)
     report = FeedReport(workflows=total, depth=executor.depth)
     prof = ReplayProfiler()
     buffers = [np.empty((chunk_workflows, max_events, num_lanes),
@@ -107,7 +131,11 @@ def _feed(blobs: Sequence[bytes], max_events: int, chunk_workflows: int,
     def launch(ci, packed):
         # async dispatch: the device crunches while later chunks pack
         with prof.leg(m.M_PROFILE_H2D):
-            device_chunk = jax.device_put(packed)
+            if mesh is not None:
+                from ..parallel.mesh import place_corpus
+                device_chunk = place_corpus(packed, mesh)
+            else:
+                device_chunk = jax.device_put(packed)
             prof.h2d(packed.nbytes)
         return replay_fn(device_chunk, layout)
 
@@ -133,7 +161,7 @@ def feed_serialized(blobs: Sequence[bytes], max_events: int,
                     chunk_workflows: int = 4096,
                     layout: PayloadLayout = DEFAULT_LAYOUT,
                     num_threads: Optional[int] = None,
-                    depth: Optional[int] = None
+                    depth: Optional[int] = None, mesh=None
                     ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Replay W serialized histories chunk-by-chunk; returns
     (payload rows [W, width], errors [W], FeedReport)."""
@@ -141,14 +169,14 @@ def feed_serialized(blobs: Sequence[bytes], max_events: int,
 
     return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
                  packing.NUM_LANES, np.int64, packing.pack_serialized,
-                 replay_to_payload, depth=depth)
+                 replay_to_payload, depth=depth, mesh=mesh)
 
 
 def feed_serialized32(blobs: Sequence[bytes], max_events: int,
                       chunk_workflows: int = 4096,
                       layout: PayloadLayout = DEFAULT_LAYOUT,
                       num_threads: Optional[int] = None,
-                      depth: Optional[int] = None
+                      depth: Optional[int] = None, mesh=None
                       ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """The production ingest pipeline: wire bytes → C++ wire32 packer →
     int32 H2D (44% of the int64 bytes) → device replay+checksum → 4
@@ -158,14 +186,14 @@ def feed_serialized32(blobs: Sequence[bytes], max_events: int,
 
     return _feed(blobs, max_events, chunk_workflows, layout, num_threads,
                  NUM_LANES32, np.int32, packing.pack_serialized32,
-                 replay_to_crc32, depth=depth)
+                 replay_to_crc32, depth=depth, mesh=mesh)
 
 
 def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
                           chunk_workflows: int = 4096,
                           layout: PayloadLayout = DEFAULT_LAYOUT,
                           num_threads: Optional[int] = None,
-                          depth: Optional[int] = None
+                          depth: Optional[int] = None, mesh=None
                           ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """The COMPRESSED ingest pipeline: wire bytes → C++ int64 packer →
     numpy wirec compression (~10-18 B/event, ops/wirec.py) → H2D → device
@@ -183,8 +211,10 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
     from ..ops.replay import replay_wirec_to_crc
     from ..ops.wirec import ProfileMisfit, pack_wirec
 
+    mesh = _resolve_mesh(mesh)
+    chunk_workflows = _mesh_chunk(chunk_workflows, mesh)
     total = len(blobs)
-    executor = BulkReplayExecutor(depth=depth)
+    executor = BulkReplayExecutor(depth=depth, mesh=mesh)
     report = FeedReport(workflows=total, depth=executor.depth)
     prof = ReplayProfiler()
     buffers = [np.empty((chunk_workflows, max_events, packing.NUM_LANES),
@@ -246,9 +276,13 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
 
     def launch(ci, corpus):
         with prof.leg(m.M_PROFILE_H2D):
-            parts = (jax.device_put(corpus.slab),
-                     jax.device_put(corpus.bases),
-                     jax.device_put(corpus.n_events))
+            if mesh is not None:
+                from ..parallel.mesh import shard_wirec
+                parts = shard_wirec(corpus, mesh)
+            else:
+                parts = (jax.device_put(corpus.slab),
+                         jax.device_put(corpus.bases),
+                         jax.device_put(corpus.n_events))
             prof.h2d(corpus.wire_bytes)
         return replay_wirec_to_crc(*parts, corpus.profile, layout)
 
@@ -276,7 +310,7 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
 def feed_corpus(histories, chunk_workflows: int = 4096,
                 layout: PayloadLayout = DEFAULT_LAYOUT,
                 max_events: int = 0,
-                depth: Optional[int] = None
+                depth: Optional[int] = None, mesh=None
                 ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed an in-memory corpus."""
     from ..core.codec import serialize_corpus
@@ -285,13 +319,13 @@ def feed_corpus(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized(serialize_corpus(histories), max_events,
-                           chunk_workflows, layout, depth=depth)
+                           chunk_workflows, layout, depth=depth, mesh=mesh)
 
 
 def feed_corpus32(histories, chunk_workflows: int = 4096,
                   layout: PayloadLayout = DEFAULT_LAYOUT,
                   max_events: int = 0,
-                  depth: Optional[int] = None
+                  depth: Optional[int] = None, mesh=None
                   ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed a corpus through the wire32 pipeline."""
     from ..core.codec import serialize_corpus
@@ -300,13 +334,13 @@ def feed_corpus32(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized32(serialize_corpus(histories), max_events,
-                             chunk_workflows, layout, depth=depth)
+                             chunk_workflows, layout, depth=depth, mesh=mesh)
 
 
 def feed_corpus_wirec(histories, chunk_workflows: int = 4096,
                       layout: PayloadLayout = DEFAULT_LAYOUT,
                       max_events: int = 0,
-                      depth: Optional[int] = None
+                      depth: Optional[int] = None, mesh=None
                       ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
     """Convenience: serialize + feed a corpus through the compressed
     wirec pipeline."""
@@ -316,4 +350,5 @@ def feed_corpus_wirec(histories, chunk_workflows: int = 4096,
     if max_events <= 0:
         max_events = max(history_length(h) for h in histories)
     return feed_serialized_wirec(serialize_corpus(histories), max_events,
-                                 chunk_workflows, layout, depth=depth)
+                                 chunk_workflows, layout, depth=depth,
+                                 mesh=mesh)

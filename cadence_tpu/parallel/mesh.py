@@ -16,6 +16,8 @@ pipeline analog, see SURVEY.md §2.6).
 """
 from __future__ import annotations
 
+import os
+import zlib
 from functools import partial
 from typing import Optional, Tuple
 
@@ -30,6 +32,12 @@ from ..ops.replay import replay_events
 
 SHARD_AXIS = "shard"
 
+#: serving-mesh width knob: how many devices the SERVING hot path
+#: (engine/executor.py replay paths, verify/rebuild/feeder/bench) shards
+#: across. Unset/1 = single-chip (byte-identical to the pre-mesh
+#: executor); 0 or "all" = every visible device; n = the first n.
+MESH_DEVICES_ENV = "CADENCE_TPU_MESH_DEVICES"
+
 
 def make_mesh(devices: Optional[list] = None) -> Mesh:
     """1D mesh over all (or given) devices; axis 'shard' partitions the
@@ -37,6 +45,54 @@ def make_mesh(devices: Optional[list] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def mesh_devices_requested() -> int:
+    """Parse the CADENCE_TPU_MESH_DEVICES knob WITHOUT touching a JAX
+    backend (callers like ServiceHost pre-register metrics before any
+    device work): 0 means "all visible devices", otherwise a count with
+    a floor of 1."""
+    raw = os.environ.get(MESH_DEVICES_ENV, "1").strip().lower()
+    if raw in ("all", "pod"):
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        return 1
+    return 0 if n == 0 else max(1, n)
+
+
+def serving_mesh(devices: Optional[list] = None) -> Mesh:
+    """The serving executor's mesh, resolved from the env knob: the one
+    mesh verify/rebuild/feeder/bench fan their chunks across. Defaults
+    to a mesh of 1 so unconfigured deployments stay byte-identical to
+    the single-chip executor."""
+    if devices is None:
+        n = mesh_devices_requested()
+        devices = jax.devices()
+        if n:
+            devices = devices[:min(n, len(devices))]
+    return make_mesh(devices)
+
+
+def workflow_shard(key: Tuple[str, str, str], n_shards: int) -> int:
+    """Stable workflow→shard assignment over the mesh — the device-mesh
+    analog of the reference's workflowID→historyShard hash
+    (common/config numHistoryShards): the same key always lands on the
+    same mesh position, so per-device state (the sharded resident pool)
+    stays on its owning device across calls."""
+    if n_shards <= 1:
+        return 0
+    return zlib.crc32("|".join(key).encode()) % n_shards
+
+
+def place_corpus(array: np.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Per-device H2D staging of any leading-workflow-axis array: the
+    device_put against a NamedSharding splits the HOST array and copies
+    each shard slice to its own device — N parallel transfers instead of
+    one chip absorbing the whole corpus."""
+    spec = P(SHARD_AXIS, *([None] * (np.ndim(array) - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))
 
 
 def shard_events(events: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:

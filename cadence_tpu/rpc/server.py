@@ -213,6 +213,25 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         for gauge in (cm.M_RESIDENT_BYTES, cm.M_RESIDENT_ENTRIES,
                       cm.M_RESIDENT_BUDGET_BYTES):
             self.metrics.gauge(cm.SCOPE_TPU_RESIDENT, gauge, 0.0)
+        # mesh-aware executor series likewise pre-registered, with the
+        # per-device labels the CADENCE_TPU_MESH_DEVICES knob implies
+        # (the knob is parsed WITHOUT touching a JAX backend; "all"
+        # resolves at first dispatch, so only dev0 pre-registers then)
+        from ..parallel.mesh import mesh_devices_requested
+        n_mesh = mesh_devices_requested() or 1
+        self.metrics.inc(cm.SCOPE_TPU_EXECUTOR, cm.M_EXEC_CHUNKS, 0)
+        self.metrics.gauge(cm.SCOPE_TPU_EXECUTOR, cm.M_EXEC_DEVICE_BUSY,
+                           0.0)
+        for d in range(n_mesh):
+            self.metrics.inc(
+                cm.SCOPE_TPU_EXECUTOR,
+                cm.device_metric(cm.M_EXEC_CHUNKS, d), 0)
+            self.metrics.inc(
+                cm.SCOPE_TPU_EXECUTOR,
+                cm.device_metric(cm.M_EXEC_ROWS, d), 0)
+            self.metrics.gauge(
+                cm.SCOPE_TPU_EXECUTOR,
+                cm.device_metric(cm.M_EXEC_DEVICE_BUSY, d), 0.0)
         # wire chaos can also arrive via dynamicconfig (the env var is the
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)

@@ -62,18 +62,23 @@ class KernelVariantCache:
         return m.DEFAULT_REGISTRY
 
     def get(self, key: Hashable, build: Callable[[], Callable],
-            registry=None) -> Callable:
+            registry=None, scope: str = "") -> Callable:
         """`registry` routes THIS call's hit/miss counters (a shared
         cache serves ladders bound to different per-cluster registries;
         each caller's counters must land on its own /metrics scrape);
-        falls back to the cache-level registry, then the default."""
+        falls back to the cache-level registry, then the default.
+        `scope` routes the counters' metric scope — the ladder's
+        tpu.fallback by default; the mesh-aware serving executor passes
+        its own so a warm serving run can prove zero recompiles without
+        reading fallback series."""
         from . import metrics as m
 
         reg = registry if registry is not None else self._registry()
+        scope = scope or m.SCOPE_TPU_FALLBACK
         with self._lock:
             fn = self._fns.get(key)
         if fn is not None:
-            reg.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_CACHE_HITS)
+            reg.inc(scope, m.M_LADDER_CACHE_HITS)
             return fn
         built = build()
         with self._lock:
@@ -81,10 +86,10 @@ class KernelVariantCache:
         if fn is built:
             # exactly one winner per key counts the miss/compile, even
             # when two ladder passes race on the same variant
-            reg.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_CACHE_MISSES)
-            reg.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_COMPILES)
+            reg.inc(scope, m.M_LADDER_CACHE_MISSES)
+            reg.inc(scope, m.M_LADDER_COMPILES)
         else:
-            reg.inc(m.SCOPE_TPU_FALLBACK, m.M_LADDER_CACHE_HITS)
+            reg.inc(scope, m.M_LADDER_CACHE_HITS)
         return fn
 
     def clear(self) -> None:

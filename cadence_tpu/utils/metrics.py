@@ -42,6 +42,10 @@ SCOPE_REBUILD = "tpu.device-rebuilder"
 SCOPE_PACK_CACHE = "tpu.pack-cache"
 SCOPE_TPU_FALLBACK = "tpu.fallback"
 SCOPE_TPU_RESIDENT = "tpu.resident"
+#: the mesh-aware bulk executor's own scope (engine/executor.py):
+#: chunks-dispatched / pack-queue-wait / device-busy, with PER-DEVICE
+#: series (device_metric) when the executor runs over a mesh
+SCOPE_TPU_EXECUTOR = "tpu.executor"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -119,11 +123,28 @@ M_LADDER_RESIDUAL = "residual-oracle-rows"
 M_LADDER_COMPILES = "rung-compiles"
 M_LADDER_CACHE_HITS = "compile-cache-hits"
 M_LADDER_CACHE_MISSES = "compile-cache-misses"
+#: mesh-aware executor counters (engine/executor.py, SCOPE_TPU_EXECUTOR):
+#: chunks dispatched to the device mesh (plus a device_metric series per
+#: mesh position) and the per-device busy gauge — in-flight chunks whose
+#: shard slice occupies that device; rows-dispatched counts REAL workflow
+#: rows per device slice (padding excluded), so skewed shard population
+#: is visible on a scrape
+M_EXEC_CHUNKS = "chunks-dispatched"
+M_EXEC_ROWS = "rows-dispatched"
+M_EXEC_DEVICE_BUSY = "device-busy"
 
 
 def ladder_rung_rows(rung: int) -> str:
     """Per-rung row counter name: rows-rung1, rows-rung2, ..."""
     return f"rows-rung{rung}"
+
+
+def device_metric(name: str, device: int) -> str:
+    """Per-device series name: chunks-dispatched-dev0, device-busy-dev3,
+    ... — the device label of the mesh-aware executor's metrics (the
+    registry keys on flat (scope, name), so the label rides the name the
+    same way ladder_rung_rows carries the rung)."""
+    return f"{name}-dev{device}"
 
 
 #: latency buckets (seconds): sub-ms sync paths through multi-second

@@ -15,7 +15,19 @@
 #   - the incremental gate holds: append transactions through the
 #     resident-state cache cost O(new events) — long-history appends
 #     within 1.5x of short-history appends at equal suffix size
-#     (detail.incremental in the recorded JSON).
+#     (detail.incremental in the recorded JSON);
+#   - the MESH gate holds (TestMeshGate): the serving executor on a mesh
+#     of 1 stays byte-identical to the unsharded kernel, warm passes
+#     recompile nothing across mesh shapes already seen, mesh-of-N
+#     checksums equal mesh-of-1 (detail.mesh_serving.checksum_identity),
+#     the recorded mesh-of-1 rate holds vs baseline, and per-device
+#     efficiency ≥ 0.7 on a REAL multi-device mesh (a virtual CPU mesh
+#     time-shares cores, so only the identity half applies there). The
+#     gate runs on a virtual-device CPU mesh via the same
+#     --xla_force_host_platform_device_count trick dryrun_multichip
+#     uses; CADENCE_TPU_MESH_DEVICES (default 8 here, default 1 in
+#     production serving — set it to shard the serving hot path across
+#     N devices) sizes it.
 # The assertions live in tests/test_perf_gate.py, marked `perf`.
 #
 # Usage: deploy/smoke_perf.sh [baseline.json] [extra pytest args]
@@ -40,6 +52,43 @@ env BENCH_NS_WORKFLOWS="${BENCH_NS_WORKFLOWS:-16384}" \
     BENCH_INCR_SHORT="${BENCH_INCR_SHORT:-32}" \
     BENCH_INCR_LONG="${BENCH_INCR_LONG:-256}" \
     python bench.py > "$OUT"
+
+# mesh gate, on a virtual-device CPU mesh (the dryrun_multichip
+# XLA_FLAGS trick; tests/conftest.py applies the same flag, so the
+# in-process mesh tests see CADENCE_TPU_MESH_DEVICES virtual devices).
+# When the main bench ran on a SINGLE device its recorded mesh_serving
+# section is vacuous (devices=1, identity trivially true) — re-measure
+# the serving executor on the virtual mesh and splice that in, so the
+# recorded checksum-identity/rate gate always covers N > 1. A
+# multi-device bench (real hardware) keeps its genuine section, and the
+# ≥0.7 efficiency gate engages on it.
+MESH_N="${CADENCE_TPU_MESH_DEVICES:-8}"
+env CADENCE_TPU_MESH_DEVICES="$MESH_N" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=${MESH_N}" \
+    JAX_PLATFORMS=cpu \
+    BENCH_MESH_WORKFLOWS="${BENCH_MESH_WORKFLOWS:-1024}" \
+    python - "$OUT" <<'PY'
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+out = sys.argv[1]
+doc = json.load(open(out))
+if doc["detail"].get("mesh_serving", {}).get("devices", 1) <= 1:
+    import bench
+    from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+    from cadence_tpu.utils import compile_cache
+    compile_cache.enable()
+    doc["detail"]["mesh_serving"] = bench._mesh_serving(
+        int(os.environ["BENCH_MESH_WORKFLOWS"]), DEFAULT_LAYOUT)
+    json.dump(doc, open(out, "w"))
+    print("mesh_serving re-measured on the virtual mesh:",
+          doc["detail"]["mesh_serving"]["devices"], "devices")
+PY
+env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \
+    CADENCE_TPU_MESH_DEVICES="$MESH_N" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=${MESH_N}" \
+    JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_perf_gate.py::TestMeshGate -m perf -q
 
 exec env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \
     JAX_PLATFORMS=cpu python -m pytest tests/test_perf_gate.py \

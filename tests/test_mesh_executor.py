@@ -1,0 +1,277 @@
+"""Mesh-aware serving executor (ISSUE 7).
+
+Covers, on the conftest-provided 8-device virtual CPU mesh:
+
+- mesh-of-1 byte parity with the unsharded kernel (dense payload rows
+  AND wirec CRCs) — the serving path at N=1 is the pre-mesh single-chip
+  executor, bit for bit;
+- mesh-of-2/4 checksum identity with mesh-of-1 on the basic /
+  timer_retry / ndc suites — sharding the workflow axis never changes a
+  row's result;
+- the engine's verify path under a mesh: escalated (capacity-flagged)
+  rows resolve identically at every mesh width, and resident suffix
+  appends land on — and stay on — the owning device
+  (parallel/mesh.workflow_shard);
+- per-device observability series under tpu.executor/* and the sharded
+  resident pool's per-device byte gauges;
+- feeder and rebuilder parity through the same mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cadence_tpu.engine.executor import replay_corpus_mesh, stream_wirec_mesh
+from cadence_tpu.engine.persistence import Stores
+from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.ops.encode import encode_corpus
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.parallel.mesh import (
+    make_mesh,
+    mesh_devices_requested,
+    serving_mesh,
+    workflow_shard,
+)
+from cadence_tpu.utils import metrics as m
+
+SEED = 20260730
+
+
+def _events(suite="basic", n=24, seed=3, target=24):
+    return encode_corpus(generate_corpus(suite, num_workflows=n, seed=seed,
+                                         target_events=target))
+
+
+def _stores_with(hists):
+    stores = Stores()
+    keys = []
+    for h in hists:
+        key = (h[0].domain_id, h[0].workflow_id, h[0].run_id)
+        for b in h:
+            stores.history.append_batch(*key, list(b.events))
+        stores.execution.upsert_workflow(StateBuilder().replay_history(h))
+        keys.append(key)
+    return stores, keys
+
+
+class TestServingPathParity:
+    def test_mesh_of_1_dense_byte_identical_to_unsharded(self):
+        """The pre-change invariant: the serving executor on a mesh of 1
+        must produce the exact payload rows (and CRC XOR) of the
+        unsharded single-chip kernel."""
+        from cadence_tpu.core.checksum import crc32_of_rows
+        from cadence_tpu.ops.replay import replay_to_payload
+
+        ev = _events()
+        rows_ref, err_ref = replay_to_payload(jnp.asarray(ev))
+        rows_ref, err_ref = np.asarray(rows_ref), np.asarray(err_ref)
+        rows, errors, _branch, report = replay_corpus_mesh(
+            ev, make_mesh(jax.devices()[:1]), chunk_workflows=8)
+        assert report.chunks == 3  # genuinely chunked, not one launch
+        assert (rows == rows_ref).all()
+        assert (errors == err_ref).all()
+        assert (int(np.bitwise_xor.reduce(
+            crc32_of_rows(rows).astype(np.uint32)))
+            == int(np.bitwise_xor.reduce(
+                crc32_of_rows(rows_ref).astype(np.uint32))))
+
+    def test_mesh_of_1_wirec_crc_identical_to_oneshot(self):
+        from cadence_tpu.ops.replay import replay_wirec_to_crc
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        corpus = pack_wirec(_events(n=24))
+        crc_ref, err_ref = replay_wirec_to_crc(
+            jnp.asarray(corpus.slab), jnp.asarray(corpus.bases),
+            jnp.asarray(corpus.n_events), corpus.profile)
+        crc_ref = np.asarray(crc_ref).astype(np.uint32)
+        crcs, errors, _rep = stream_wirec_mesh(
+            corpus, make_mesh(jax.devices()[:1]), n_chunks=2)
+        assert (crcs == crc_ref).all()
+        assert (errors == np.asarray(err_ref)).all()
+
+    @pytest.mark.parametrize("suite", ["basic", "timer_retry", "ndc"])
+    @pytest.mark.parametrize("n_dev", [2, 4])
+    def test_mesh_n_checksum_identity(self, suite, n_dev):
+        """Mesh-of-N payload rows equal mesh-of-1 on the same corpus —
+        the PR-5 diagnostic invariant, now on the serving path."""
+        devices = jax.devices()
+        assert len(devices) >= n_dev
+        ev = _events(suite=suite, n=16, seed=11)
+        rows_1, err_1, _b1, _ = replay_corpus_mesh(
+            ev, make_mesh(devices[:1]), chunk_workflows=8)
+        rows_n, err_n, _bn, _ = replay_corpus_mesh(
+            ev, make_mesh(devices[:n_dev]), chunk_workflows=8)
+        assert (rows_n == rows_1).all()
+        assert (err_n == err_1).all()
+
+
+class TestEngineMeshVerify:
+    def test_verify_all_mesh2_with_escalated_rows(self):
+        """The engine's full verify path at mesh-of-2 vs mesh-of-1 on an
+        overflow corpus: identical verified counts, the SAME keys
+        resolved by the widened-K ladder (escalation rides the sharded
+        kernels), zero divergence either way."""
+        hists = generate_corpus("overflow", num_workflows=96, seed=SEED,
+                                target_events=60)
+        devices = jax.devices()
+        stores1, keys1 = _stores_with(hists)
+        r1 = TPUReplayEngine(stores1, chunk_workflows=32, pipeline_depth=2,
+                             mesh=make_mesh(devices[:1])).verify_all(keys1)
+        stores2, keys2 = _stores_with(hists)
+        r2 = TPUReplayEngine(stores2, chunk_workflows=32, pipeline_depth=2,
+                             mesh=make_mesh(devices[:2])).verify_all(keys2)
+        assert r1.ok and r2.ok
+        assert r1.verified_on_device == r2.verified_on_device == len(keys1)
+        assert sorted(r1.escalated) == sorted(r2.escalated)
+        assert len(r1.escalated) >= 1
+        assert r1.fallback == r2.fallback == []
+
+    def test_resident_suffix_append_lands_on_owning_device(self):
+        """Verify seeds the sharded resident pool, an appended batch
+        takes the suffix path, and the re-admitted state row lives on
+        the device its key hashes to — before AND after the append."""
+        hists = generate_corpus("basic", num_workflows=12, seed=7,
+                                target_events=30)
+        devices = jax.devices()
+        mesh = make_mesh(devices[:2])
+        stores = Stores()
+        keys = []
+        for h in hists:
+            key = (h[0].domain_id, h[0].workflow_id, h[0].run_id)
+            for b in h[:-1]:
+                stores.history.append_batch(*key, list(b.events))
+            stores.execution.upsert_workflow(
+                StateBuilder().replay_history(h[:-1]))
+            keys.append(key)
+        engine = TPUReplayEngine(stores, chunk_workflows=8,
+                                 pipeline_depth=2, mesh=mesh)
+        assert engine.verify_all(keys).ok
+        assert len(engine.resident) >= 1
+
+        def owning_ok(key):
+            shard = workflow_shard(key, 2)
+            entry = engine.resident._slices[shard].get(key)
+            if entry is None:
+                return None
+            leaf = jax.tree_util.tree_leaves(entry.state)[0]
+            return leaf.devices() == {mesh.devices.flat[shard]}
+
+        seeded = [k for k in keys if owning_ok(k)]
+        assert seeded, "no resident entries on their owning device"
+        assert all(owning_ok(k) for k in seeded)
+
+        # append the held-back last batch: the suffix path must serve it
+        # and the widened/re-admitted row must STAY on the owning device
+        for h, key in zip(hists, keys):
+            stores.history.append_batch(*key, list(h[-1].events))
+            stores.execution.upsert_workflow(
+                StateBuilder().replay_history(h), set_current=False)
+        result = engine.verify_all(keys)
+        assert result.ok
+        reg = engine.metrics
+        assert reg.counter(m.SCOPE_TPU_RESIDENT,
+                           m.M_RESIDENT_SUFFIX_HITS) >= 1
+        for k in keys:
+            assert owning_ok(k) in (True, None)
+        assert any(owning_ok(k) for k in keys)
+
+    def test_per_device_series_on_metrics(self):
+        """tpu.executor/* gains device-labelled series (chunks, rows,
+        busy gauge) and the sharded resident pool exports per-device
+        byte gauges — all reachable through prometheus exposition."""
+        hists = generate_corpus("basic", num_workflows=16, seed=5,
+                                target_events=24)
+        stores, keys = _stores_with(hists)
+        engine = TPUReplayEngine(stores, chunk_workflows=8,
+                                 pipeline_depth=2,
+                                 mesh=make_mesh(jax.devices()[:2]))
+        assert engine.verify_all(keys).ok
+        reg = engine.metrics
+        assert reg.counter(m.SCOPE_TPU_EXECUTOR, m.M_EXEC_CHUNKS) >= 2
+        for d in range(2):
+            assert reg.counter(
+                m.SCOPE_TPU_EXECUTOR,
+                m.device_metric(m.M_EXEC_CHUNKS, d)) >= 2
+            assert reg.counter(
+                m.SCOPE_TPU_EXECUTOR,
+                m.device_metric(m.M_EXEC_ROWS, d)) >= 1
+        # busy gauge settled back to zero after the run
+        assert reg.gauge_value(m.SCOPE_TPU_EXECUTOR,
+                               m.M_EXEC_DEVICE_BUSY) == 0.0
+        prom = reg.to_prometheus()
+        assert 'cadence_chunks_dispatched_dev0_total{scope="tpu.executor"}' \
+            in prom
+        assert 'cadence_device_busy_dev1{scope="tpu.executor"}' in prom
+        # sharded resident pool: per-device occupancy gauges
+        assert reg.gauge_value(m.SCOPE_TPU_RESIDENT,
+                               m.device_metric(m.M_RESIDENT_BYTES, 0)) \
+            + reg.gauge_value(m.SCOPE_TPU_RESIDENT,
+                              m.device_metric(m.M_RESIDENT_BYTES, 1)) > 0
+
+    def test_resident_budget_splits_per_device(self):
+        from cadence_tpu.engine.resident import ResidentStateCache
+
+        cache = ResidentStateCache(budget_bytes=1 << 20,
+                                   mesh=make_mesh(jax.devices()[:4]))
+        assert cache.n_shards == 4
+        assert cache.slice_budget == (1 << 20) // 4
+        # rebinding to a different width drops entries (placement moved)
+        cache.set_mesh(make_mesh(jax.devices()[:2]))
+        assert cache.n_shards == 2 and len(cache) == 0
+
+
+class TestMeshConsumers:
+    def test_rebuilder_mesh_parity(self):
+        from cadence_tpu.core.checksum import STICKY_ROW_INDEX, payload_row
+        from cadence_tpu.engine.rebuild import DeviceRebuilder
+
+        hists = generate_corpus("timer_retry", num_workflows=10, seed=9,
+                                target_events=24)
+        rb = DeviceRebuilder(chunk_jobs=4,
+                             mesh=make_mesh(jax.devices()[:2]))
+        states = rb.rebuild([(h, None) for h in hists])
+        assert rb.stats.device == len(hists)
+        assert rb.stats.oracle_fallback == 0
+        for ms, h in zip(states, hists):
+            got = payload_row(ms)
+            got[STICKY_ROW_INDEX] = 0
+            expected = payload_row(StateBuilder().replay_history(h))
+            expected[STICKY_ROW_INDEX] = 0
+            assert (got == expected).all()
+
+    def test_feeder_mesh_parity(self):
+        from cadence_tpu.native import packing
+        from cadence_tpu.native.feeder import feed_corpus
+        from cadence_tpu.ops.replay import replay_corpus
+
+        if not packing.native_available():
+            pytest.skip("native packer unavailable")
+        hists = generate_corpus("basic", num_workflows=18, seed=7,
+                                target_events=24)
+        rows_direct, _, errors_direct = replay_corpus(hists)
+        rows, errors, report = feed_corpus(
+            hists, chunk_workflows=6, depth=3,
+            mesh=make_mesh(jax.devices()[:2]))
+        assert report.chunks == 3
+        assert (errors == errors_direct).all()
+        assert (rows == rows_direct).all()
+
+    def test_serving_mesh_env_knob(self, monkeypatch):
+        monkeypatch.delenv("CADENCE_TPU_MESH_DEVICES", raising=False)
+        assert mesh_devices_requested() == 1
+        assert int(serving_mesh().devices.size) == 1
+        monkeypatch.setenv("CADENCE_TPU_MESH_DEVICES", "4")
+        assert mesh_devices_requested() == 4
+        assert int(serving_mesh().devices.size) == 4
+        monkeypatch.setenv("CADENCE_TPU_MESH_DEVICES", "all")
+        assert mesh_devices_requested() == 0
+        assert int(serving_mesh().devices.size) == len(jax.devices())
+
+    def test_workflow_shard_stable(self):
+        key = ("d", "wf", "run")
+        assert workflow_shard(key, 1) == 0
+        for n in (2, 4, 8):
+            s = workflow_shard(key, n)
+            assert 0 <= s < n
+            assert workflow_shard(key, n) == s  # deterministic

@@ -187,6 +187,96 @@ class TestIncrementalGate:
             f"1.5x of short {p50_s}ms")
 
 
+class TestMeshGate:
+    """The mesh-aware serving executor gate (ISSUE 7): mesh-of-1 must be
+    byte-identical to the unsharded kernel (the pre-mesh single-chip
+    executor's results), mesh shapes already seen must recompile nothing
+    on a warm pass, and the recorded bench's mesh_serving section must
+    hold its rate vs the baseline (and ≥ 0.7 per-device efficiency on a
+    real multi-device mesh — virtual CPU meshes share physical cores and
+    report overhead, so only checksum identity is gated there)."""
+
+    def _events(self, n=48, seed=31):
+        return encode_corpus(generate_corpus(
+            "basic", num_workflows=n, seed=seed, target_events=24))
+
+    def test_mesh_of_1_byte_parity_with_unsharded_kernel(self):
+        import jax
+        import jax.numpy as jnp
+
+        from cadence_tpu.engine.executor import replay_corpus_mesh
+        from cadence_tpu.ops.replay import replay_to_payload
+        from cadence_tpu.parallel.mesh import make_mesh
+
+        ev = self._events()
+        rows_ref, err_ref = replay_to_payload(jnp.asarray(ev))
+        rows_ref, err_ref = np.asarray(rows_ref), np.asarray(err_ref)
+        rows, errors, _branch, _rep = replay_corpus_mesh(
+            ev, make_mesh(jax.devices()[:1]), chunk_workflows=16)
+        assert (rows == rows_ref).all()
+        assert (errors == err_ref).all()
+
+    def test_warm_pass_zero_recompiles_across_seen_mesh_shapes(self):
+        import jax
+
+        from cadence_tpu.engine.executor import replay_corpus_mesh
+        from cadence_tpu.parallel.mesh import make_mesh
+        from cadence_tpu.utils import metrics as cm
+
+        ev = self._events()
+        devices = jax.devices()
+        meshes = [make_mesh(devices[:1])]
+        if len(devices) >= 2:
+            meshes.append(make_mesh(devices[:2]))
+        for mesh in meshes:  # first pass: compiles allowed
+            replay_corpus_mesh(ev, mesh, chunk_workflows=16)
+        reg = cm.DEFAULT_REGISTRY
+        misses0 = reg.counter(cm.SCOPE_TPU_EXECUTOR,
+                              cm.M_LADDER_CACHE_MISSES)
+        for mesh in meshes:  # warm pass: every variant must hit
+            replay_corpus_mesh(ev, mesh, chunk_workflows=16)
+        assert reg.counter(cm.SCOPE_TPU_EXECUTOR,
+                           cm.M_LADDER_CACHE_MISSES) == misses0, \
+            "a warm serving pass recompiled a mesh shape already seen"
+        assert reg.counter(cm.SCOPE_TPU_EXECUTOR,
+                           cm.M_LADDER_CACHE_HITS) >= len(meshes)
+
+    def test_mesh_serving_rate_vs_baseline(self):
+        """Recorded gate: the serving executor's mesh-of-1 rate must
+        stay within PERF_TOLERANCE of the recorded baseline's — the
+        mesh layer is a scaling axis, not a single-chip regression."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("mesh_serving")
+        assert cur, "current bench carries no mesh_serving section"
+        assert cur["checksum_identity"], \
+            "mesh-of-N checksums diverged from mesh-of-1"
+        base = _load_bench("PERF_BASELINE").get("detail",
+                                                {}).get("mesh_serving")
+        if not base:
+            pytest.skip("baseline predates the mesh_serving section")
+        tol = float(os.environ.get("PERF_TOLERANCE", "0.5"))
+        floor = tol * base["rate_n1"]
+        assert cur["rate_n1"] >= floor, (
+            f"mesh-of-1 serving rate {cur['rate_n1']} regressed below "
+            f"{tol:.0%} of baseline {base['rate_n1']}")
+
+    def test_per_device_efficiency_on_real_mesh(self):
+        """≥ 0.7 per-device efficiency at the diagnostic's device count
+        — on real accelerators only: a virtual CPU mesh time-shares
+        physical cores, so its efficiency measures overhead and only
+        the checksum-identity half of the contract applies."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("mesh_serving")
+        assert cur, "current bench carries no mesh_serving section"
+        if cur["devices"] <= 1:
+            pytest.skip("single-device bench run")
+        assert cur["checksum_identity"]
+        if cur.get("virtual_mesh"):
+            pytest.skip("virtual CPU mesh: efficiency reports overhead, "
+                        "not speedup (dryrun_multichip docstring)")
+        assert cur["per_device_efficiency"] >= 0.7, (
+            f"per-device efficiency {cur['per_device_efficiency']} "
+            f"below 0.7 at {cur['devices']} devices")
+
+
 class TestBaselineGate:
     def _load(self, env):
         return _load_bench(env)
