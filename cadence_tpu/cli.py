@@ -79,8 +79,10 @@ def _emit(obj: Any) -> None:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="cadence-tpu", description="cadence_tpu operator CLI")
-    parser.add_argument("--wal", required=True,
-                        help="cluster WAL path (durable state)")
+    parser.add_argument("--wal", default="",
+                        help="cluster WAL path (durable state; required "
+                             "for every group except `load`, which "
+                             "launches its own wire cluster)")
     sub = parser.add_subparsers(dest="group", required=True)
 
     # domain
@@ -205,7 +207,50 @@ def main(argv=None) -> int:
     crun.add_argument("--cycles", type=int, default=10)
     crun.add_argument("--interval", type=float, default=0.0)
 
+    # open-loop load harness (bench/ + canary/ load tooling,
+    # cadence_tpu/loadgen/): launches a REAL wire cluster, drives seeded
+    # open-loop traffic, evaluates latency SLOs, optionally records a
+    # LOADGEN_r0N.json trajectory next to BENCH_r*.json
+    load_grp = sub.add_parser("load").add_subparsers(dest="cmd",
+                                                     required=True)
+    for cmd_name in ("run", "overload"):
+        lp = load_grp.add_parser(cmd_name)
+        lp.add_argument("--duration", type=float, default=10.0)
+        lp.add_argument("--hosts", type=int, default=2)
+        lp.add_argument("--seed", type=int, default=20260803)
+        lp.add_argument("--workers", type=int, default=24)
+        lp.add_argument("--chaos", default="",
+                        help="wire chaos spec for every process "
+                             "(rpc/chaos.py), e.g. "
+                             "'drop=0.04,sever=0.02,delay=0.1,seed=17'")
+        lp.add_argument("--no-verify", action="store_true",
+                        help="skip the post-run oracle<->device checksum "
+                             "verification")
+        lp.add_argument("--record", action="store_true",
+                        help="write the next LOADGEN_r0N.json in CWD")
+        lp.add_argument("--out", default="",
+                        help="explicit trajectory path (implies --record)")
+        if cmd_name == "run":
+            lp.add_argument("--domains", default="lg-a,lg-b",
+                            help="comma-separated domain names")
+            lp.add_argument("--rps", type=float, default=3.0,
+                            help="scheduled arrival rate per domain")
+            lp.add_argument("--p99-slo-ms", type=float, default=2500.0)
+        else:
+            lp.add_argument("--victim-rps", type=float, default=4.0)
+            lp.add_argument("--aggressor-quota-rps", type=float,
+                            default=4.0)
+            lp.add_argument("--overdrive", type=float, default=2.0,
+                            help="aggressor drive rate as a multiple of "
+                                 "its quota")
+            lp.add_argument("--victim-p99-slo-ms", type=float,
+                            default=2500.0)
+
     args = parser.parse_args(argv)
+    if args.group == "load":
+        return _load_tool(args)
+    if not args.wal:
+        parser.error(f"--wal is required for the {args.group} group")
     if args.group == "wal":
         return _wal_tool(args)
     # schema tools run BEFORE cluster recovery (the cassandra/sql-tool
@@ -484,6 +529,36 @@ def main(argv=None) -> int:
         _emit(report.summary())
         return 0 if report.ok else 1
     return 0
+
+
+def _load_tool(args) -> int:
+    """`load run` / `load overload` (cadence_tpu/loadgen/scenarios.py):
+    exit 0 iff the scenario's gate held (SLOs, shed ratio, zero
+    checksum divergence)."""
+    _ensure_jax_backend()
+    from .loadgen import report as lg_report
+    from .loadgen import scenarios
+
+    if args.cmd == "overload":
+        doc = scenarios.overload_scenario(
+            duration_s=args.duration, num_hosts=args.hosts,
+            victim_rps=args.victim_rps,
+            aggressor_quota_rps=args.aggressor_quota_rps,
+            overdrive=args.overdrive, chaos_spec=args.chaos,
+            seed=args.seed, victim_p99_slo_ms=args.victim_p99_slo_ms,
+            workers=args.workers, verify=not args.no_verify)
+    else:
+        doc = scenarios.mixed_scenario(
+            duration_s=args.duration, num_hosts=args.hosts,
+            domains=[d for d in args.domains.split(",") if d],
+            rps_per_domain=args.rps, chaos_spec=args.chaos,
+            seed=args.seed, p99_slo_ms=args.p99_slo_ms,
+            workers=args.workers, verify=not args.no_verify)
+    if args.record or args.out:
+        path = lg_report.write_trajectory(doc, path=args.out or None)
+        doc["trajectory"] = path
+    _emit(doc)
+    return 0 if doc["ok"] else 1
 
 
 def _wal_tool(args) -> int:

@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import List, Tuple
 
 from ..utils.log import DEFAULT_LOGGER
-from ..utils.quotas import TokenBucket
+from ..utils.quotas import ServiceBusyError, TokenBucket
 
 OP_TERMINATE = "terminate"
 OP_CANCEL = "cancel"
@@ -34,6 +34,11 @@ class BatchReport:
 
 
 class Batcher:
+    #: per-record retry budget against quota sheds before the record is
+    #: reported failed (quota refills between attempts; only a quota far
+    #: below the batch's demand exhausts it)
+    SHED_RETRIES = 8
+
     def __init__(self, frontend, rps: float = 50.0, logger=None) -> None:
         self.frontend = frontend
         self.rps = rps
@@ -59,22 +64,35 @@ class Batcher:
         report.total = len(targets)
         self.log.info("batch starting", domain=domain, op=operation,
                       query=query, targets=report.total)
+        import time
         for rec in targets:
             while not limiter.allow():
-                import time
                 time.sleep(1.0 / max(self.rps, 1.0))
             try:
-                if operation == OP_TERMINATE:
-                    self.frontend.terminate_workflow_execution(
-                        domain, rec.workflow_id, run_id=rec.run_id,
-                        reason=reason)
-                elif operation == OP_CANCEL:
-                    self.frontend.request_cancel_workflow_execution(
-                        domain, rec.workflow_id, run_id=rec.run_id)
-                else:
-                    self.frontend.signal_workflow_execution(
-                        domain, rec.workflow_id, signal_name,
-                        run_id=rec.run_id)
+                for attempt in range(self.SHED_RETRIES):
+                    try:
+                        if operation == OP_TERMINATE:
+                            self.frontend.terminate_workflow_execution(
+                                domain, rec.workflow_id, run_id=rec.run_id,
+                                reason=reason)
+                        elif operation == OP_CANCEL:
+                            self.frontend.request_cancel_workflow_execution(
+                                domain, rec.workflow_id, run_id=rec.run_id)
+                        else:
+                            self.frontend.signal_workflow_execution(
+                                domain, rec.workflow_id, signal_name,
+                                run_id=rec.run_id)
+                        break
+                    except ServiceBusyError as exc:
+                        # the domain quota shedding a batch op is
+                        # BACKPRESSURE, not a per-record failure: honor
+                        # the retry-after hint and try the same record
+                        # again (bounded, so a near-zero quota still
+                        # surfaces as failures instead of a hung batch)
+                        if attempt == self.SHED_RETRIES - 1:
+                            raise
+                        time.sleep(max(float(exc.retry_after_s or 0.0),
+                                       1.0 / max(self.rps, 1.0)))
                 report.succeeded += 1
             except Exception as exc:  # per-execution isolation
                 report.failures.append((rec.workflow_id, rec.run_id,

@@ -93,12 +93,47 @@ class Frontend:
                                                  domain=d),
             burst=lambda: self.config.get(KEY_FRONTEND_BURST),
         )
+        #: domains granted a per-domain metrics series, capped: the name
+        #: comes straight from the request BEFORE the domain is validated,
+        #: and a spray of junk domain names must never grow the registry
+        #: (and every /metrics scrape) without bound — the same guard
+        #: quotas.Collection applies to its buckets
+        self._metric_domains: set = set()
 
     def _admit(self, domain: str, scope: str) -> None:
+        """Admission control (quotas/multistageratelimiter.go seat): charge
+        the request against the per-domain stage then the global stage.
+        Over-limit requests shed with a typed ServiceBusyError carrying a
+        retry-after estimate — overload degrades by rejecting cheaply at
+        the door, never by queueing into latency collapse. Every decision
+        lands on the `quotas` scope (admitted/shed + per-domain series),
+        so a /metrics scrape shows WHICH domain is being shed."""
         from ..utils.quotas import ServiceBusyError
-        if not self.rate_limiter.allow(domain):
+        try:
+            self.rate_limiter.admit(domain)
+        except ServiceBusyError:
             self.metrics.inc(scope, m.M_RATE_LIMITED)
-            raise ServiceBusyError(f"domain {domain} over request limit")
+            self.metrics.inc(m.SCOPE_QUOTAS, m.M_QUOTA_SHED)
+            series = self._domain_series(m.M_QUOTA_SHED, domain)
+            if series:
+                self.metrics.inc(m.SCOPE_QUOTAS, series)
+            raise
+        self.metrics.inc(m.SCOPE_QUOTAS, m.M_QUOTA_ADMITTED)
+        series = self._domain_series(m.M_QUOTA_ADMITTED, domain)
+        if series:
+            self.metrics.inc(m.SCOPE_QUOTAS, series)
+
+    #: per-domain quota series cap — beyond it only the totals count
+    MAX_DOMAIN_SERIES = 256
+
+    def _domain_series(self, name: str, domain: str) -> Optional[str]:
+        """Per-domain series name, or None once the cap is hit (totals
+        still count; only the per-domain breakdown saturates)."""
+        if domain not in self._metric_domains:
+            if len(self._metric_domains) >= self.MAX_DOMAIN_SERIES:
+                return None
+            self._metric_domains.add(domain)
+        return m.domain_metric(name, domain)
 
     def _authorize(self, api: str, permission: str, domain: str = "") -> None:
         from .authorization import AuthAttributes, check
@@ -257,6 +292,7 @@ class Frontend:
         from .domain import require_active
         self._authorize("RequestCancelWorkflowExecution", PERMISSION_WRITE,
                         domain)
+        self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         info = self.stores.domain.by_name(domain)
         require_active(info, self.cluster_name)
         self.router(workflow_id).request_cancel_workflow(info.domain_id,
@@ -268,6 +304,7 @@ class Frontend:
         from .authorization import PERMISSION_WRITE
         from .domain import require_active
         self._authorize("TerminateWorkflowExecution", PERMISSION_WRITE, domain)
+        self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         info = self.stores.domain.by_name(domain)
         require_active(info, self.cluster_name)
         self.router(workflow_id).terminate_workflow(info.domain_id,
@@ -283,6 +320,7 @@ class Frontend:
         from .authorization import PERMISSION_WRITE
         from .domain import require_active
         self._authorize("ResetWorkflowExecution", PERMISSION_WRITE, domain)
+        self._admit(domain, m.SCOPE_FRONTEND_RESET)
         info = self.stores.domain.by_name(domain)
         require_active(info, self.cluster_name)
         domain_id = info.domain_id
@@ -407,6 +445,7 @@ class Frontend:
         dispatched directly through matching."""
         from ..core.enums import EMPTY_EVENT_ID, WorkflowState
         from .history_engine import InvalidRequestError
+        self._admit(domain, m.SCOPE_FRONTEND_QUERY)
         domain_id = self.stores.domain.by_name(domain).domain_id
         engine = self.router(workflow_id)
         ms = engine.get_mutable_state(domain_id, workflow_id, run_id)
@@ -498,6 +537,9 @@ class Frontend:
         notifier until events beyond `last_event_id` exist or the workflow
         closes (the reference's close-event wait policy), instead of
         busy-reading."""
+        # admission charges at ENTRY (one token per call, long-poll or
+        # not): a parked long-poll holds a notifier slot, not a quota
+        self._admit(domain, m.SCOPE_FRONTEND_READ)
         info = self.stores.domain.by_name(domain)
         domain_id = info.domain_id
         engine = self.router(workflow_id)
@@ -587,6 +629,7 @@ class Frontend:
     def describe_workflow_execution(self, domain: str, workflow_id: str,
                                     run_id: Optional[str] = None
                                     ) -> MutableState:
+        self._admit(domain, m.SCOPE_FRONTEND_READ)
         domain_id = self.stores.domain.by_name(domain).domain_id
         return self.router(workflow_id).get_mutable_state(domain_id,
                                                           workflow_id, run_id)

@@ -1,0 +1,97 @@
+"""Latency SLO evaluation over a load report.
+
+An `SLO` names a slice of the traffic — op kind and/or domain, "*"
+matching all — and the ceilings it must hold: latency percentiles
+(measured from INTENDED send time, generator.py) and optionally a
+maximum non-shed error rate. Sheds are NOT errors here: an overloaded
+domain being rejected by admission control is the system working as
+designed; the victim domain's latency holding is what the SLO gates.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .generator import LoadReport
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Ceilings for one traffic slice; None = not gated."""
+
+    op: str = "*"
+    domain: str = "*"
+    p50_ms: Optional[float] = None
+    p99_ms: Optional[float] = None
+    p999_ms: Optional[float] = None
+    max_error_rate: Optional[float] = None
+
+    def matches(self, kind: str, domain: str) -> bool:
+        return (self.op in ("*", kind)
+                and self.domain in ("*", domain))
+
+
+@dataclass
+class SLOCheck:
+    op: str
+    domain: str
+    metric: str
+    limit: float
+    observed: float
+    ok: bool
+
+    def as_dict(self) -> dict:
+        return {"op": self.op, "domain": self.domain, "metric": self.metric,
+                "limit": round(self.limit, 4),
+                "observed": round(self.observed, 4), "ok": self.ok}
+
+
+@dataclass
+class SLOReport:
+    checks: List[SLOCheck] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    @property
+    def violations(self) -> List[SLOCheck]:
+        return [c for c in self.checks if not c.ok]
+
+    def as_dict(self) -> dict:
+        return {"ok": self.ok,
+                "checks": [c.as_dict() for c in self.checks],
+                "violations": len(self.violations)}
+
+
+def evaluate_slos(report: LoadReport, slos: List[SLO]) -> SLOReport:
+    """Evaluate every SLO against every (op, domain) slice it matches.
+    Latency limits check the slice's own histogram percentiles; the
+    error-rate limit checks errors/sent (sheds excluded — they are the
+    admission door doing its job, gated separately by the scenario)."""
+    out = SLOReport()
+    slices: List[Tuple[str, str]] = sorted(report.stats.keys())
+    for slo in slos:
+        for kind, domain in slices:
+            if not slo.matches(kind, domain):
+                continue
+            stats = report.stats[(kind, domain)]
+            if stats.sent == 0:
+                continue
+            pct: Dict[str, float] = report.percentiles(kind, domain)
+            for metric, limit in (("p50_ms", slo.p50_ms),
+                                  ("p99_ms", slo.p99_ms),
+                                  ("p999_ms", slo.p999_ms)):
+                if limit is None:
+                    continue
+                observed = pct[metric.replace("_ms", "")] * 1000.0
+                out.checks.append(SLOCheck(
+                    op=kind, domain=domain, metric=metric, limit=limit,
+                    observed=observed, ok=observed <= limit))
+            if slo.max_error_rate is not None:
+                rate = stats.errors / stats.sent
+                out.checks.append(SLOCheck(
+                    op=kind, domain=domain, metric="error_rate",
+                    limit=slo.max_error_rate, observed=rate,
+                    ok=rate <= slo.max_error_rate))
+    return out

@@ -292,13 +292,32 @@ class ClusterGroup:
             c.stop()
 
 
+def _role_env(env_extra, env_per_role, role: str, generic: str):
+    """Compose one process's environment overlay: `env_extra` (every
+    process) + the generic-role overlay ("host"/"store") + the exact
+    role-name overlay (e.g. "host-1", "primary-host-0"), later layers
+    winning. Loadgen uses the per-role seam to hand EACH host its own
+    quota knobs (CADENCE_TPU_QUOTAS — a cluster RPS budget split across
+    hosts because every host's token buckets are local)."""
+    env = dict(env_extra or {})
+    per = env_per_role or {}
+    env.update(per.get(generic, {}))
+    env.update(per.get(role, {}))
+    return env
+
+
 def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
                  num_shards: int = 8, hb_interval: float = 0.15,
-                 ttl: float = 3.0) -> ClusterGroup:
+                 ttl: float = 3.0, env_extra=None,
+                 env_per_role=None) -> ClusterGroup:
     """Launch a multi-cluster group: per cluster one store server + N
     service hosts, every host configured with the peer clusters' store
     addresses (the cluster-group config) so its leader runs the inbound
-    replication/domain/cross-cluster consumers against real sockets."""
+    replication/domain/cross-cluster consumers against real sockets.
+
+    `env_extra` lands in EVERY spawned process; `env_per_role` overlays
+    it per role: keys are "store", "host", or an exact process name —
+    here host names carry the cluster prefix ("primary-host-0")."""
     store_ports = {name: free_port() for name in cluster_names}
     clusters: Dict[str, Cluster] = {}
     try:
@@ -308,7 +327,8 @@ def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
             clusters[name] = launch(
                 num_hosts=num_hosts, num_shards=num_shards,
                 hb_interval=hb_interval, ttl=ttl, cluster_name=name,
-                store_port=store_ports[name], peer_specs=peers)
+                store_port=store_ports[name], peer_specs=peers,
+                env_extra=env_extra, env_per_role=env_per_role)
     except Exception:
         for c in clusters.values():
             c.stop()
@@ -319,27 +339,33 @@ def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
 def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
            hb_interval: float = 0.15, ttl: float = 3.0,
            cluster_name: str = "primary", store_port: int = 0,
-           peer_specs=(), env_extra=None) -> Cluster:
+           peer_specs=(), env_extra=None, env_per_role=None) -> Cluster:
     """Spawn the store server + `num_hosts` service hosts as OS processes.
     The TTL must comfortably exceed worst-case heartbeat jitter (a
     GIL-starved beat thread on a loaded host): a too-tight TTL makes the
     failure detector flap, and every flap is a spurious steal — safe
     (fencing holds) but churny. Test-sized here; production stretches both.
     `env_extra` lands in every spawned process — the chaos soak sets
-    CADENCE_TPU_CHAOS / CADENCE_TPU_STORE_FAULTS through it."""
-    env = dict(os.environ)
-    env.update(env_extra or {})
-    env.setdefault("JAX_PLATFORMS", "cpu")  # control-plane processes
+    CADENCE_TPU_CHAOS / CADENCE_TPU_STORE_FAULTS through it.
+    `env_per_role` overlays env_extra for individual processes: keys are
+    "store", "host" (every service host), or an exact host name
+    ("host-0"; with peer_specs, "<cluster>-host-0") — the loadgen hands
+    each host its own CADENCE_TPU_QUOTAS knobs through this seam."""
+    base_env = dict(os.environ)
+    base_env.setdefault("JAX_PLATFORMS", "cpu")  # control-plane processes
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    base_env["PYTHONPATH"] = repo + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
 
     store_port = store_port or free_port()
     store_cmd = [sys.executable, "-m", "cadence_tpu.rpc.storeserver",
                  "--port", str(store_port)]
     if wal:
         store_cmd += ["--wal", wal]
-    store_proc = subprocess.Popen(store_cmd, env=env)
+    store_env = dict(base_env)
+    store_env.update(_role_env(env_extra, env_per_role, "store", "store"))
+    store_proc = subprocess.Popen(store_cmd, env=store_env)
     _wait_listening(store_port, store_proc)
 
     hosts: Dict[str, int] = {}
@@ -358,7 +384,9 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
                "--http-port", str(http_port)]
         for spec in peer_specs:
             cmd += ["--peer", spec]
-        procs[name] = subprocess.Popen(cmd, env=env)
+        host_env = dict(base_env)
+        host_env.update(_role_env(env_extra, env_per_role, name, "host"))
+        procs[name] = subprocess.Popen(cmd, env=host_env)
         hosts[name] = port
         http_ports[name] = http_port
     for name, port in hosts.items():

@@ -24,6 +24,7 @@ Run: python -m cadence_tpu.rpc.server --name host-0 --port P \
 from __future__ import annotations
 
 import argparse
+import os
 import socketserver
 import threading
 from contextlib import nullcontext
@@ -232,6 +233,29 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             self.metrics.gauge(
                 cm.SCOPE_TPU_EXECUTOR,
                 cm.device_metric(cm.M_EXEC_DEVICE_BUSY, d), 0.0)
+        # per-host quota knobs (common/quotas seat): the env var is the
+        # subprocess-cluster path (rpc/cluster.launch env_per_role hands
+        # each host its own spec — a cluster-wide RPS budget is split
+        # across hosts because each host's buckets are local); values
+        # land in dynamicconfig, so the frontend's live closures pick
+        # them up and later operator config.set updates still win
+        from ..utils import quotas as quotas_mod
+        quota_spec = os.environ.get(quotas_mod.QUOTAS_ENV, "")
+        if quota_spec:
+            g_rps, g_burst, domain_rps = quotas_mod.parse_quota_spec(
+                quota_spec)
+            if g_rps:
+                self.config.set(dc.KEY_FRONTEND_RPS, g_rps)
+            if g_burst:
+                self.config.set(dc.KEY_FRONTEND_BURST, g_burst)
+            for domain, rps in domain_rps.items():
+                self.config.set(dc.KEY_FRONTEND_DOMAIN_RPS, rps,
+                                domain=domain)
+        # admission-control series pre-registered: a scrape shows
+        # quotas/admitted + quotas/shed as zero before the first request
+        # (per-domain series appear as domains take traffic)
+        self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_ADMITTED, 0)
+        self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_SHED, 0)
         # wire chaos can also arrive via dynamicconfig (the env var is the
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)

@@ -51,8 +51,20 @@ SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
 SCOPE_HISTORY_RECORD_STARTED = "history.record-decision-task-started"
 SCOPE_FRONTEND_POLL_DECISION = "frontend.poll-for-decision-task"
+SCOPE_FRONTEND_RESET = "frontend.reset-workflow-execution"
+SCOPE_FRONTEND_QUERY = "frontend.query-workflow"
+SCOPE_FRONTEND_READ = "frontend.read"
 SCOPE_MATCHING_POLL_DECISION = "matching.poll-decision-task"
 SCOPE_MATCHING_ADD_DECISION = "matching.add-decision-task"
+#: the admission-control seat (common/quotas, PAPER §1 layer 5): every
+#: frontend API charged against the multi-stage limiter counts here —
+#: `admitted`/`shed` totals plus per-domain series (domain_metric), so a
+#: scrape shows WHICH domain is being shed while the others hold
+SCOPE_QUOTAS = "quotas"
+#: the open-loop load generator's own scopes ride "loadgen.<op-kind>"
+#: (cadence_tpu/loadgen/generator.py); per-domain latency series use the
+#: same domain_metric labeling as the quota counters
+SCOPE_LOADGEN_PREFIX = "loadgen"
 
 # -- metric names -----------------------------------------------------------
 
@@ -132,6 +144,10 @@ M_LADDER_CACHE_MISSES = "compile-cache-misses"
 M_EXEC_CHUNKS = "chunks-dispatched"
 M_EXEC_ROWS = "rows-dispatched"
 M_EXEC_DEVICE_BUSY = "device-busy"
+#: admission-control counters (SCOPE_QUOTAS): requests the multi-stage
+#: limiter admitted vs shed (typed ServiceBusyError with retry-after)
+M_QUOTA_ADMITTED = "admitted"
+M_QUOTA_SHED = "shed"
 
 
 def ladder_rung_rows(rung: int) -> str:
@@ -145,6 +161,14 @@ def device_metric(name: str, device: int) -> str:
     registry keys on flat (scope, name), so the label rides the name the
     same way ladder_rung_rows carries the rung)."""
     return f"{name}-dev{device}"
+
+
+def domain_metric(name: str, domain: str) -> str:
+    """Per-domain series name: shed-domain-hot, latency-domain-payments,
+    ... — the domain label of the quota/loadgen metrics, riding the flat
+    (scope, name) key exactly like device_metric's device label
+    (to_prometheus sanitizes the domain into the metric grammar)."""
+    return f"{name}-domain-{domain}"
 
 
 #: latency buckets (seconds): sub-ms sync paths through multi-second

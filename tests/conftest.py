@@ -56,6 +56,11 @@ def _fresh_default_observability():
     # never leak into another's assertions or memory budget
     from cadence_tpu.engine import resident
     resident.reset_all()
+    # quota limiters are held by reference inside frontends the same
+    # way: drain one test's consumed tokens so they never shed the next
+    # test's first requests
+    from cadence_tpu.utils import quotas
+    quotas.reset_all()
     yield
 
 

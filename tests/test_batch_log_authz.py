@@ -80,6 +80,49 @@ class TestBatcher:
         with pytest.raises(ValueError):
             Batcher(box.frontend).run(DOMAIN, "", "signal")
 
+    def test_quota_sheds_are_retried_not_failed(self):
+        """A ServiceBusyError from the admission door is backpressure:
+        the batcher must honor the retry-after hint and re-apply the
+        SAME record, not log it as a permanent per-record failure — and
+        a quota that never admits must eventually fail the record
+        instead of hanging the batch."""
+        from types import SimpleNamespace
+
+        from cadence_tpu.utils.quotas import ServiceBusyError
+
+        class _QuotaFrontend:
+            def __init__(self, sheds_before_admit):
+                self.sheds_before_admit = sheds_before_admit
+                self.attempts = {}
+                self.terminated = []
+
+            def list_workflow_executions(self, domain, query):
+                return [SimpleNamespace(workflow_id=f"wf-{i}", run_id="r",
+                                        close_status=-1) for i in range(4)]
+
+            def terminate_workflow_execution(self, domain, workflow_id,
+                                             run_id=None, reason=""):
+                n = self.attempts.get(workflow_id, 0)
+                self.attempts[workflow_id] = n + 1
+                if n < self.sheds_before_admit:
+                    raise ServiceBusyError("over limit",
+                                           retry_after_s=0.005,
+                                           domain=domain)
+                self.terminated.append(workflow_id)
+
+        fe = _QuotaFrontend(sheds_before_admit=2)
+        report = Batcher(fe, rps=1000).run(DOMAIN, "", "terminate")
+        assert report.succeeded == 4 and report.failed == 0
+        assert sorted(fe.terminated) == [f"wf-{i}" for i in range(4)]
+        # every record took the shed → retry → admit path
+        assert all(n == 3 for n in fe.attempts.values())
+        # a quota that NEVER admits: bounded retries, then failure
+        fe2 = _QuotaFrontend(sheds_before_admit=10_000)
+        report2 = Batcher(fe2, rps=1000).run(DOMAIN, "", "terminate")
+        assert report2.succeeded == 0 and report2.failed == 4
+        assert all(n == Batcher.SHED_RETRIES
+                   for n in fe2.attempts.values())
+
 
 class TestStructuredLogging:
     def test_tagged_lines_on_transaction_paths(self, box, caplog):

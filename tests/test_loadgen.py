@@ -1,0 +1,409 @@
+"""Open-loop load harness (ISSUE 8 / ROADMAP item 4).
+
+Three layers, cheapest first:
+
+- schedule construction (`loadgen/mixes.py`): seeded reproducibility —
+  identical seeds must produce byte-identical traffic traces — and
+  per-domain stream independence;
+- the open-loop property itself (`loadgen/generator.py`), pinned with a
+  deliberately STALLED fake server: latency is clocked from each op's
+  INTENDED send time, so a backlogged server shows growing user-facing
+  latency while its service latency stays flat — the generator can
+  never degrade to closed-loop measurement (coordinated omission);
+- the wire-cluster overload gate (`loadgen/scenarios.py`, marker
+  `load`, deploy/smoke_load.sh): one domain driven at 2x its quota
+  under seeded wire chaos — the victim domain's p99 holds its SLO,
+  >= 90% of the aggressor's overflow sheds as typed ServiceBusy
+  (counters on /metrics), and every completed workflow verifies
+  oracle<->device with zero checksum divergence.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from cadence_tpu.loadgen import report as report_mod
+from cadence_tpu.loadgen import scenarios
+from cadence_tpu.loadgen.generator import (
+    DecisionCompleters,
+    LoadGenerator,
+)
+from cadence_tpu.loadgen.mixes import (
+    ALL_OPS,
+    OP_QUERY,
+    OP_SIGNAL,
+    OP_START,
+    POOL_OPS,
+    STANDARD_MIX,
+    START_ONLY_MIX,
+    DomainPlan,
+    TrafficMix,
+    build_schedule,
+    pool_workflow_ids,
+    trace_digest,
+)
+from cadence_tpu.loadgen.slo import SLO, evaluate_slos
+from cadence_tpu.utils.quotas import ServiceBusyError
+
+START_ONLY = TrafficMix("start-only", {OP_START: 1.0})
+
+
+# -- schedules: seeded reproducibility --------------------------------------
+
+class TestSchedules:
+    def test_same_seed_reproduces_identical_trace(self):
+        plans = [DomainPlan("d-a", 40, mix=STANDARD_MIX),
+                 DomainPlan("d-b", 25, mix=START_ONLY)]
+        s1 = build_schedule(plans, duration_s=3.0, seed=7)
+        s2 = build_schedule(plans, duration_s=3.0, seed=7)
+        assert s1 == s2
+        assert trace_digest(s1) == trace_digest(s2)
+        assert len(s1) > 100
+
+    def test_different_seed_different_trace(self):
+        plans = [DomainPlan("d-a", 40)]
+        a = build_schedule(plans, duration_s=2.0, seed=1)
+        b = build_schedule(plans, duration_s=2.0, seed=2)
+        assert trace_digest(a) != trace_digest(b)
+
+    def test_domain_streams_independent(self):
+        """Adding a domain must not perturb another domain's trace (each
+        stream is seeded by (seed, domain))."""
+        alone = build_schedule([DomainPlan("d-a", 30)], 2.0, seed=9)
+        merged = build_schedule([DomainPlan("d-a", 30),
+                                 DomainPlan("d-b", 50)], 2.0, seed=9)
+        a_ops = [(op.at_s, op.kind, op.workflow_id, op.arg)
+                 for op in merged if op.domain == "d-a"]
+        assert a_ops == [(op.at_s, op.kind, op.workflow_id, op.arg)
+                        for op in alone]
+
+    def test_schedule_is_open_loop_and_sorted(self):
+        plans = [DomainPlan("d-u", 50, arrival="uniform", mix=START_ONLY)]
+        sched = build_schedule(plans, duration_s=2.0, seed=3)
+        # uniform lattice: exactly rps*duration - 1 arrivals strictly
+        # inside (0, duration)
+        assert len(sched) == 99
+        ats = [op.at_s for op in sched]
+        assert ats == sorted(ats)
+        assert all(0 < t < 2.0 for t in ats)
+        assert [op.index for op in sched] == list(range(len(sched)))
+
+    def test_nonpositive_rps_rejected(self):
+        # the CLI's --rps is an unvalidated float; rps <= 0 would divide
+        # by zero or walk scheduled time backwards forever
+        with pytest.raises(ValueError, match="rps must be > 0"):
+            DomainPlan("d-bad", 0.0)
+        with pytest.raises(ValueError, match="rps must be > 0"):
+            DomainPlan("d-bad", -1.0)
+
+    def test_population_targeting(self):
+        plans = [DomainPlan("d-p", 80, pool_size=4)]
+        sched = build_schedule(plans, duration_s=2.0, seed=11)
+        pool = set(pool_workflow_ids(plans[0]))
+        start_ids = [op.workflow_id for op in sched
+                     if op.kind not in POOL_OPS
+                     and op.kind != "signal-with-start"]
+        assert len(start_ids) == len(set(start_ids))  # churn ids unique
+        for op in sched:
+            if op.kind in POOL_OPS:
+                assert op.workflow_id in pool
+        assert {op.kind for op in sched} <= set(ALL_OPS)
+
+
+# -- the open-loop property -------------------------------------------------
+
+class _StalledClient:
+    """Fake frontend whose every op takes `stall` seconds of service
+    time: a closed-loop driver would report `stall` per op; the open
+    loop must report the GROWING backlog."""
+
+    def __init__(self, stall: float) -> None:
+        self.stall = stall
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def start_workflow_execution(self, *a, **k):
+        with self._lock:
+            self.calls += 1
+        time.sleep(self.stall)
+
+
+class _SheddingClient:
+    """Fake frontend shedding every other request with the typed quota
+    rejection (retry-after riding along, like the real frontend)."""
+
+    def __init__(self) -> None:
+        self.calls = 0
+
+    def start_workflow_execution(self, *a, **k):
+        self.calls += 1
+        if self.calls % 2 == 0:
+            raise ServiceBusyError("over request limit",
+                                   retry_after_s=0.125, domain="d-s")
+
+
+class TestOpenLoop:
+    def test_stalled_server_latency_clocks_from_intended_time(self):
+        """THE open-loop pin: one worker, 0.1s service stall, arrivals
+        scheduled every 12.5ms. A closed-loop driver would report ~0.1s
+        per op; the open loop must report the backlog — the last op's
+        user-facing latency is ~n*stall while its SERVICE latency stays
+        ~stall. Coordinated omission is structurally impossible."""
+        stall = 0.1
+        plan = DomainPlan("d-o", 80, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.2, seed=1)  # 15 ops
+        n = len(sched)
+        client = _StalledClient(stall)
+        gen = LoadGenerator([client], sched, [plan], workers=1)
+        rep = gen.run()
+        assert client.calls == n
+        lat = rep.percentiles(OP_START, metric="latency")
+        svc = rep.percentiles(OP_START, metric="service-latency")
+        # service latency: every op ~0.1s — p99 within one bucket of it
+        assert svc["p99"] <= 0.25
+        # user-facing latency: the backlog (~n*stall at the tail).
+        # p50 alone proves the divergence: half the ops waited > 3x the
+        # service time, which a closed-loop measurement cannot show.
+        assert lat["p50"] >= 3 * stall
+        assert lat["p99"] >= 0.5 * n * stall
+        assert rep.duration_s >= n * stall * 0.9
+
+    def test_sheds_are_counted_not_errors(self):
+        plan = DomainPlan("d-s", 100, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.1, seed=2)
+        client = _SheddingClient()
+        gen = LoadGenerator([client], sched, [plan], workers=2)
+        rep = gen.run()
+        t = rep.totals()
+        assert t.sent == len(sched)
+        assert t.shed == len(sched) // 2
+        assert t.errors == 0
+        assert rep.max_retry_after_s == pytest.approx(0.125)
+        # shed series mirror the server-side quotas counters
+        scope = "loadgen.start"
+        assert rep.registry.counter(scope, "shed") == t.shed
+        assert rep.registry.counter(scope,
+                                    "shed-domain-d-s") == t.shed
+
+    def test_breaker_sheds_kept_apart_from_quota_sheds(self):
+        """A client-side breaker ServiceBusy never reached a host, so it
+        must NOT count into `shed` (which the overload gate compares
+        one-for-one against the server's quotas/shed counters) — it gets
+        its own `shed_busy` bucket."""
+        from cadence_tpu.utils.circuitbreaker import ServiceBusy
+
+        class _BreakerClient:
+            calls = 0
+
+            def start_workflow_execution(self, *a, **k):
+                _BreakerClient.calls += 1
+                if _BreakerClient.calls % 2 == 0:
+                    raise ServiceBusy("circuit open")
+
+        plan = DomainPlan("d-b", 100, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.1, seed=2)
+        gen = LoadGenerator([_BreakerClient()], sched, [plan], workers=2)
+        rep = gen.run()
+        t = rep.totals()
+        assert t.shed_busy == len(sched) // 2
+        assert t.shed == 0 and t.errors == 0
+        assert rep.registry.counter("loadgen.start",
+                                    "shed-busy") == t.shed_busy
+        assert rep.registry.counter("loadgen.start", "shed") == 0
+
+    def test_unknown_exception_counted_by_type(self):
+        class _Boom:
+            def start_workflow_execution(self, *a, **k):
+                raise RuntimeError("boom")
+        plan = DomainPlan("d-e", 50, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.1, seed=3)
+        gen = LoadGenerator([_Boom()], sched, [plan], workers=2)
+        rep = gen.run()
+        t = rep.totals()
+        assert t.errors == t.sent > 0
+        assert rep.stats[(OP_START, "d-e")].error_types == {
+            "RuntimeError": t.sent}
+
+
+# -- SLO evaluation ---------------------------------------------------------
+
+class TestSLO:
+    def _report(self):
+        plan = DomainPlan("d-slo", 100, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.1, seed=4)
+        gen = LoadGenerator([_StalledClient(0.0)], sched, [plan], workers=4)
+        return gen.run()
+
+    def test_slo_pass_and_violation(self):
+        rep = self._report()
+        ok = evaluate_slos(rep, [SLO(domain="d-slo", p99_ms=5000.0)])
+        assert ok.ok and ok.checks and not ok.violations
+        bad = evaluate_slos(rep, [SLO(domain="d-slo", p99_ms=0.0001)])
+        assert not bad.ok
+        assert [c.metric for c in bad.violations] == ["p99_ms"]
+        assert bad.as_dict()["violations"] == 1
+
+    def test_error_rate_excludes_sheds(self):
+        plan = DomainPlan("d-s", 100, mix=START_ONLY, arrival="uniform")
+        sched = build_schedule([plan], duration_s=0.1, seed=5)
+        gen = LoadGenerator([_SheddingClient()], sched, [plan], workers=1)
+        rep = gen.run()
+        # half the traffic shed, ZERO errors: a 1% error SLO still holds
+        out = evaluate_slos(rep, [SLO(max_error_rate=0.01)])
+        assert out.ok
+
+    def test_slo_slice_matching(self):
+        s = SLO(op=OP_SIGNAL, domain="d-x", p50_ms=1)
+        assert s.matches(OP_SIGNAL, "d-x")
+        assert not s.matches(OP_SIGNAL, "d-y")
+        assert not s.matches(OP_QUERY, "d-x")
+        assert SLO().matches(OP_QUERY, "anything")
+
+
+# -- trajectory files -------------------------------------------------------
+
+class TestTrajectory:
+    def test_numbering_and_schema(self, tmp_path):
+        root = str(tmp_path)
+        assert report_mod.latest_trajectory_path(root) is None
+        p1 = report_mod.write_trajectory({"ok": True}, root=root)
+        assert p1.endswith("LOADGEN_r01.json")
+        p2 = report_mod.write_trajectory({"ok": True}, root=root)
+        assert p2.endswith("LOADGEN_r02.json")
+        assert report_mod.latest_trajectory_path(root) == p2
+        import json
+        doc = json.load(open(p1))
+        assert doc["schema"] == report_mod.SCHEMA
+
+
+# -- in-process integration (Onebox) ---------------------------------------
+
+class TestOneboxIntegration:
+    def test_mixed_traffic_runs_and_verifies(self):
+        """The full generator loop against an in-process cluster: seeded
+        pools, every op kind executing, latency percentiles recorded per
+        domain, oracle<->device verify green over the traffic's
+        output."""
+        from cadence_tpu.engine.onebox import Onebox
+        box = Onebox(num_hosts=1, num_shards=4)
+        plans = [DomainPlan("lg-ob-a", 12, pool_size=3),
+                 DomainPlan("lg-ob-b", 12, pool_size=3)]
+        sched = build_schedule(plans, duration_s=1.5, seed=6)
+        gen = LoadGenerator([box.frontend], sched, plans, workers=8,
+                            pump=box.pump_once)
+        gen.prepare(setup_deadline_s=30.0)
+        completers = DecisionCompleters(
+            lambda: box.frontend, [p.domain for p in plans],
+            per_domain=1, poll_wait=0.05)
+        completers.start()
+        try:
+            rep = gen.run()
+        finally:
+            completers.stop()
+        # bounded pump: cron churn re-schedules forever and unpolled
+        # signal-with-start decisions park in matching, so the box never
+        # fully quiesces — verify does not need it to
+        for _ in range(50):
+            box.pump_once()
+        t = rep.totals()
+        assert t.sent == len(sched) > 20
+        # nothing sheds (no quotas configured) and errors stay rare
+        # (signal/reset races on pool workflows are tolerated noise)
+        assert t.shed == 0
+        assert t.errors <= 0.1 * t.sent
+        for plan in plans:
+            pct = rep.percentiles(OP_START, domain=plan.domain)
+            assert 0 <= pct["p50"] <= pct["p999"] < 60
+        assert rep.trace_digest == trace_digest(sched)
+        assert box.tpu.verify_all().ok
+
+    def test_quota_sheds_surface_on_both_sides(self):
+        """Client-observed sheds == server quotas/shed counters, and the
+        victim domain stays un-shed (per-domain stage isolation)."""
+        from cadence_tpu.engine.onebox import Onebox
+        from cadence_tpu.utils import metrics as m
+        from cadence_tpu.utils.dynamicconfig import (
+            KEY_FRONTEND_DOMAIN_RPS,
+            DynamicConfig,
+        )
+        cfg = DynamicConfig()
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 2, domain="lg-hot")
+        box = Onebox(num_hosts=1, num_shards=4, config=cfg)
+        plans = [DomainPlan("lg-hot", 40, mix=START_ONLY,
+                            arrival="uniform", pool_size=1),
+                 DomainPlan("lg-cool", 5, mix=START_ONLY,
+                            arrival="uniform", pool_size=1)]
+        sched = build_schedule(plans, duration_s=1.0, seed=7)
+        gen = LoadGenerator([box.frontend], sched, plans, workers=8,
+                            pump=box.pump_once)
+        gen.prepare(setup_deadline_s=30.0)
+        rep = gen.run()
+        hot, cool = rep.totals("lg-hot"), rep.totals("lg-cool")
+        assert hot.shed > 0 and hot.errors == 0
+        assert cool.shed == 0 and cool.ok == cool.sent
+        shed_srv = box.metrics.counter(m.SCOPE_QUOTAS, m.M_QUOTA_SHED)
+        # prepare()'s seed starts can also shed; the generator's view is
+        # a lower bound, the per-domain split pins the victim at zero
+        assert shed_srv >= hot.shed
+        assert box.metrics.counter(
+            m.SCOPE_QUOTAS,
+            m.domain_metric(m.M_QUOTA_SHED, "lg-cool")) == 0
+        assert box.metrics.counter(
+            m.SCOPE_QUOTAS,
+            m.domain_metric(m.M_QUOTA_ADMITTED, "lg-cool")) >= cool.ok
+
+
+class TestScenarioValidation:
+    def test_subtoken_per_host_quota_rejected_before_launch(self):
+        """aggressor_quota_rps / num_hosts < 1 makes every per-host
+        bucket's capacity (burst=rps alias) smaller than one token —
+        permanently unadmittable. The scenario must refuse loudly up
+        front instead of hanging through prepare()'s setup deadline."""
+        with pytest.raises(ValueError, match="below one token"):
+            scenarios.overload_scenario(aggressor_quota_rps=1.0,
+                                        num_hosts=2)
+
+
+# -- the wire-cluster overload gate ----------------------------------------
+
+@pytest.mark.load
+class TestOverloadGate:
+    def test_overload_sheds_aggressor_victim_p99_holds(self):
+        """The acceptance bar (deploy/smoke_load.sh): 2-host wire
+        cluster, aggressor at 2x quota, victim on the standard mix,
+        seeded wire chaos in every process. Pass iff the victim's p99
+        holds its SLO, >= 90% of aggressor overflow sheds as typed
+        ServiceBusy visible on /metrics, and every completed workflow
+        verifies oracle<->device with zero divergence."""
+        duration = float(os.environ.get("LOADGEN_DURATION_S", "8"))
+        seed = int(os.environ.get("LOADGEN_SEED", "20260803"))
+        doc = scenarios.overload_scenario(
+            duration_s=duration, seed=seed,
+            chaos_spec=scenarios.DEFAULT_CHAOS_SPEC)
+        adm = doc["admission"]
+        agg = adm["aggressor"]
+        assert agg["shed"] > 0, doc
+        assert agg["shed_ratio_of_overflow"] >= 0.9, adm
+        # server-side counters agree with the client-observed sheds —
+        # over the measured window only (prepare-time sheds are retried
+        # client-side and excluded via the post-prepare baseline)
+        assert adm["scrape"]["shed_total_run"] == agg["shed"], adm
+        assert adm["scrape"]["prometheus_has_shed"]
+        # every shed carried a usable backoff hint
+        assert adm["max_retry_after_s"] > 0
+        # victim untouched by the aggressor's quota
+        assert adm["victim"]["shed"] == 0
+        assert doc["slo"]["ok"], doc["slo"]
+        assert doc["verify"]["divergent"] == 0, doc["verify"]
+        assert doc["verify"]["completed_workflows"] > 0
+        assert doc["ok"], doc
+        # the recorded trace is reproducible from (plans, duration, seed)
+        plans = [
+            DomainPlan(scenarios.VICTIM_DOMAIN, 4.0, mix=STANDARD_MIX,
+                       pool_size=6),
+            DomainPlan(scenarios.AGGRESSOR_DOMAIN, 8.0,
+                       mix=START_ONLY_MIX, pool_size=1),
+        ]
+        rebuilt = build_schedule(plans, duration, seed)
+        assert doc["traffic"]["trace_digest"] == trace_digest(rebuilt)

@@ -16,7 +16,14 @@ from cadence_tpu.utils.dynamicconfig import (
     KEY_MAX_BRANCHES,
     DynamicConfig,
 )
-from cadence_tpu.utils.quotas import ServiceBusyError, TokenBucket
+from cadence_tpu.utils.quotas import (
+    NANOS,
+    Collection,
+    MultiStageRateLimiter,
+    ServiceBusyError,
+    TokenBucket,
+    parse_quota_spec,
+)
 from tests.taskpoller import TaskPoller
 
 DOMAIN = "metrics-domain"
@@ -144,3 +151,211 @@ class TestQuotas:
         # other domains unaffected
         for i in range(5):
             box.frontend.start_workflow_execution("free", f"f-{i}", "t", TL)
+
+    def test_per_domain_series_capped_against_junk_domains(self):
+        """_admit charges BEFORE domain validation, so the domain name in
+        the per-domain metric series is request-supplied: a spray of junk
+        names must stop growing the registry at the cap (totals keep
+        counting) — the metrics side of quotas.Collection's no-leak
+        guard."""
+        box = Onebox(num_hosts=1, num_shards=2)
+        fe = box.frontend
+        fe.MAX_DOMAIN_SERIES = 3
+        for i in range(10):
+            with pytest.raises(Exception):  # EntityNotExist, post-admit
+                fe.start_workflow_execution(f"junk-{i}", "w", "t", TL)
+        per_domain = [name for name in box.metrics.snapshot()["quotas"]
+                      if name.startswith("admitted-domain-")]
+        assert len(per_domain) == 3
+        assert box.metrics.counter(m.SCOPE_QUOTAS, "admitted") == 10
+
+
+class TestTokenBucket:
+    """Satellite: burst semantics + the non-consuming reserve/wait path
+    (common/tokenbucket/tb.go), deterministic under ManualTimeSource."""
+
+    def test_burst_zero_aliases_to_rps(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=5, burst=0)
+        assert tb.burst == 5.0  # documented alias: one second's tokens
+        assert TokenBucket(clock, rps=5, burst=2).burst == 2.0
+        for _ in range(5):
+            assert tb.try_consume()
+        assert not tb.try_consume()
+
+    def test_try_consume_n(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=4, burst=4)
+        assert tb.try_consume(3)
+        assert not tb.try_consume(2)  # only 1 left
+        assert tb.try_consume(1)
+        clock.advance(NANOS)  # 1s -> 4 tokens back
+        assert tb.try_consume(4)
+
+    def test_time_to_is_non_consuming(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=2, burst=2)
+        assert tb.time_to() == 0.0
+        assert tb.time_to() == 0.0  # asking twice consumed nothing
+        assert tb.try_consume(2)
+        assert tb.time_to(1) == pytest.approx(0.5)
+        assert tb.time_to(2) == pytest.approx(1.0)
+        # n beyond burst capacity can never be granted in one piece
+        assert tb.time_to(3) == float("inf")
+
+    def test_wait_deterministic_on_manual_clock(self):
+        clock = ManualTimeSource()
+        sleeps = []
+
+        def manual_sleep(s):
+            sleeps.append(s)
+            clock.advance(int(s * NANOS))
+
+        tb = TokenBucket(clock, rps=2, burst=2, sleep=manual_sleep)
+        assert tb.try_consume(2)
+        assert tb.wait(1)  # slept exactly the 0.5s deficit, then got it
+        assert sleeps == pytest.approx([0.5])
+        assert not tb.try_consume()  # wait() consumed the refilled token
+
+    def test_wait_respects_deadline(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=1, burst=1,
+                         sleep=lambda s: clock.advance(int(s * NANOS)))
+        assert tb.try_consume()
+        # 1 token needs 1s; deadline only 0.2s out -> refuse WITHOUT
+        # sleeping (the clock must not advance)
+        before = clock.now()
+        assert not tb.wait(1, deadline=before + int(0.2 * NANOS))
+        assert clock.now() == before
+        # n > burst is unsatisfiable regardless of deadline
+        assert not tb.wait(5, deadline=before + 100 * NANOS)
+
+    def test_non_monotonic_clock_grants_nothing(self):
+        clock = ManualTimeSource()
+        tb = TokenBucket(clock, rps=10, burst=10)
+        assert all(tb.try_consume() for _ in range(10))
+        clock.advance(-5 * NANOS)  # NTP step-back
+        assert not tb.try_consume()  # backwards time granted no tokens
+        clock.advance(5 * NANOS)  # catch back up to the old reading
+        # re-elapsed time must not be credited: still empty
+        assert not tb.try_consume()
+        clock.advance(NANOS // 10)  # genuinely new time -> 1 token
+        assert tb.try_consume()
+        assert not tb.try_consume()
+
+    def test_unlimited_when_rps_zero(self):
+        tb = TokenBucket(ManualTimeSource(), rps=0)
+        assert all(tb.try_consume(100) for _ in range(50))
+        assert tb.time_to(1000) == 0.0
+
+
+class TestQuotaCollection:
+    """Satellite: the per-domain collection under ManualTimeSource —
+    deterministic refill, two-domain isolation, live-limit rebuild."""
+
+    def test_deterministic_refill_per_domain(self):
+        clock = ManualTimeSource()
+        limits = {"hot": 2.0, "cold": 4.0}
+        coll = Collection(clock, rps_for=lambda d: limits[d])
+        assert [coll.allow("hot") for _ in range(3)] == [True, True, False]
+        clock.advance(NANOS // 2)  # 0.5s: hot +1, cold untouched at 4
+        assert coll.allow("hot")
+        assert not coll.allow("hot")
+        assert [coll.allow("cold") for _ in range(5)] == [
+            True, True, True, True, False]
+
+    def test_two_domain_isolation(self):
+        clock = ManualTimeSource()
+        coll = Collection(clock, rps_for=lambda d: 1.0)
+        assert coll.allow("a")
+        assert not coll.allow("a")  # a exhausted...
+        assert coll.allow("b")      # ...b's bucket untouched
+
+    def test_live_limit_change_rebuilds_bucket(self):
+        clock = ManualTimeSource()
+        limits = {"d": 1.0}
+        coll = Collection(clock, rps_for=lambda d: limits[d])
+        assert coll.allow("d")
+        assert not coll.allow("d")
+        limits["d"] = 3.0  # operator raises the limit
+        # next request sees a fresh 3-rps bucket, no restart
+        assert [coll.allow("d") for _ in range(4)] == [
+            True, True, True, False]
+
+    def test_multistage_admit_carries_retry_after(self):
+        clock = ManualTimeSource()
+        lim = MultiStageRateLimiter(clock, global_rps=lambda: 100,
+                                    domain_rps=lambda d: 2,
+                                    burst=lambda: 0)
+        lim.admit("d")
+        lim.admit("d")
+        with pytest.raises(ServiceBusyError) as ei:
+            lim.admit("d")
+        assert ei.value.domain == "d"
+        assert ei.value.retry_after_s == pytest.approx(0.5)
+        assert "retry after" in str(ei.value)
+        clock.advance(NANOS // 2)
+        lim.admit("d")  # the hint was accurate
+
+    def test_domain_stage_rejection_spares_global_bucket(self):
+        """multistageratelimiter.go ordering: a hot domain's rejections
+        must not drain the global stage for everyone else."""
+        clock = ManualTimeSource()
+        lim = MultiStageRateLimiter(clock, global_rps=lambda: 3,
+                                    domain_rps=lambda d:
+                                    2 if d == "hot" else 0,
+                                    burst=lambda: 0)
+        assert lim.allow("hot") and lim.allow("hot")
+        for _ in range(10):
+            assert not lim.allow("hot")  # hot-stage rejections
+        # global stage still has its third token for the cold domain
+        assert lim.allow("cold")
+
+    def test_dynamicconfig_hot_update_takes_effect_without_restart(self):
+        """Satellite acceptance: an operator config.set on a domain's
+        RPS reaches the frontend's limiter mid-flight — the live closure
+        rebuilds that domain's bucket on its next request."""
+        cfg = DynamicConfig()
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 1, domain="tuned")
+        box = Onebox(num_hosts=1, num_shards=2, config=cfg)
+        box.frontend.register_domain("tuned")
+        box.frontend.start_workflow_execution("tuned", "h-0", "t", TL)
+        with pytest.raises(ServiceBusyError):
+            box.frontend.start_workflow_execution("tuned", "h-1", "t", TL)
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 5, domain="tuned")  # hot update
+        # the rebuilt bucket carries a fresh 5-token burst (burst=0
+        # aliases to rps): five admits, then the sixth sheds
+        for i in range(1, 6):
+            box.frontend.start_workflow_execution("tuned", f"h-{i}",
+                                                  "t", TL)
+        with pytest.raises(ServiceBusyError):
+            box.frontend.start_workflow_execution("tuned", "h-9", "t", TL)
+        # and back down: the rebuilt bucket applies the new, lower limit
+        cfg.set(KEY_FRONTEND_DOMAIN_RPS, 1, domain="tuned")
+        box.clock.advance(1_000_000_000)
+        box.frontend.start_workflow_execution("tuned", "h-10", "t", TL)
+        with pytest.raises(ServiceBusyError):
+            box.frontend.start_workflow_execution("tuned", "h-11", "t", TL)
+
+
+class TestQuotaSpec:
+    """Satellite: the CADENCE_TPU_QUOTAS per-host knob format."""
+
+    def test_round_trip(self):
+        g, b, d = parse_quota_spec(
+            "rps=200, burst=50, domain.hot=20, domain.cold=80")
+        assert (g, b) == (200.0, 50.0)
+        assert d == {"hot": 20.0, "cold": 80.0}
+
+    def test_empty_and_partial(self):
+        assert parse_quota_spec("") == (0.0, 0.0, {})
+        assert parse_quota_spec("domain.x=3") == (0.0, 0.0, {"x": 3.0})
+
+    def test_malformed_rejected_loudly(self):
+        with pytest.raises(ValueError):
+            parse_quota_spec("rps")  # no '='
+        with pytest.raises(ValueError):
+            parse_quota_spec("domain.=5")  # empty domain
+        with pytest.raises(ValueError):
+            parse_quota_spec("rsp=5")  # typo'd key must not silently
+            #                            admit everything
