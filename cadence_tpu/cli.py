@@ -454,14 +454,14 @@ def main(argv=None) -> int:
 
             from .gen.corpus import generate_corpus
             from .ops.encode import LANE_EVENT_ID, encode_corpus
+            from .native.wirec import pack_wirec_auto
             from .ops.replay import replay_wirec_to_crc
-            from .ops.wirec import pack_wirec
 
             histories = generate_corpus("basic",
                                         num_workflows=args.workflows,
                                         seed=1, target_events=args.events)
             events = encode_corpus(histories)
-            corpus = pack_wirec(events)
+            corpus = pack_wirec_auto(events)
             import jax.numpy as jnp
             arrs = (jnp.asarray(corpus.slab), jnp.asarray(corpus.bases),
                     jnp.asarray(corpus.n_events))

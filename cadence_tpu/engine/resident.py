@@ -445,10 +445,22 @@ class ResidentStateCache:
         (re-narrowing to base once narrow_ok holds); any other failure
         invalidates the entry and returns ok=False for oracle
         arbitration."""
+        return self.replay_append_report(items, encode_suffix)[0]
+
+    def replay_append_report(self, items: Sequence[Tuple[tuple,
+                                                         ResidentEntry,
+                                                         Sequence]],
+                             encode_suffix: Optional[Callable] = None
+                             ) -> Tuple[List[AppendResult], AppendReport]:
+        """`replay_append` plus THIS call's AppendReport. The report is a
+        per-call object (also published as `last_append` for the
+        observability probes) so a concurrent append on the shared cache
+        can never swap the numbers out from under the caller."""
         if encode_suffix is None:
             encode_suffix = _encode_suffix_cold
         results: List[Optional[AppendResult]] = [None] * len(items)
-        self.last_append = AppendReport(transactions=len(items))
+        report = AppendReport(transactions=len(items))
+        self.last_append = report
         # group by (rung, owning shard): states in one launch must share
         # a layout, and under a sharded pool the from-state replay (plus
         # any ladder widen it escalates into) runs on the device that
@@ -459,12 +471,12 @@ class ResidentStateCache:
                                 []).append(i)
         for (rung, shard), idxs in sorted(by_group.items()):
             self._append_group(items, idxs, rung, encode_suffix, results,
-                               shard=shard)
-        return [r if r is not None else AppendResult(ok=False)
-                for r in results]
+                               report, shard=shard)
+        return ([r if r is not None else AppendResult(ok=False)
+                 for r in results], report)
 
     def _append_group(self, items, idxs: List[int], rung: int,
-                      encode_suffix, results: List,
+                      encode_suffix, results: List, report: AppendReport,
                       shard: int = 0) -> None:
         import jax
         import jax.numpy as jnp
@@ -514,10 +526,10 @@ class ResidentStateCache:
                     pad_rows = jax.device_put(pad_rows, device)
                 states.append(pad_rows)
             s0 = self._stack_rows(states) if len(states) > 1 else states[0]
-            self.last_append.chunk_shapes.append(
+            report.chunk_shapes.append(
                 (corpus.shape[0], corpus.shape[1]))
             events = int((corpus[:, :, 0] > 0).sum())  # LANE_EVENT_ID
-            self.last_append.events_appended += events
+            report.events_appended += events
             scope.inc(m.M_RESIDENT_EVENTS_APPENDED, events)
             # the suffix lanes ship to the OWNING device: the group's
             # resident states already live there, so the whole
@@ -560,7 +572,8 @@ class ResidentStateCache:
                     bool(narrow_mask[j]) if narrow_mask is not None else False)
             if flagged:
                 self._escalate(items, [group[j] for j in flagged],
-                               corpus[[j for j in flagged]], rung, results)
+                               corpus[[j for j in flagged]], rung, results,
+                               report)
 
     def _narrow_mask(self, s_fin, rung: int):
         """[W] bool of rows that can re-narrow to base, None at base."""
@@ -585,7 +598,7 @@ class ResidentStateCache:
                             branch=branch, rung=rung)
 
     def _escalate(self, items, flat_idxs: List[int], sub: np.ndarray,
-                  rung: int, results: List) -> None:
+                  rung: int, results: List, report: AppendReport) -> None:
         """Widened re-replay of capacity-flagged appends from their
         PRE-append resident states (the entries still hold them — they
         only re-admit on success)."""
@@ -598,7 +611,7 @@ class ResidentStateCache:
             return
         scope = self._scope()
         scope.inc(m.M_RESIDENT_WIDENED, len(flat_idxs))
-        self.last_append.escalated_rows += len(flat_idxs)
+        report.escalated_rows += len(flat_idxs)
         pre_states = self._stack_rows([items[i][1].state
                                        for i in flat_idxs])
         trimmed = gather_subcorpus(sub, np.arange(sub.shape[0]))

@@ -17,6 +17,7 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "packer.cc")
 _SRC_GEN = os.path.join(_DIR, "generator.cc")
+_SRC_WIREC = os.path.join(_DIR, "wirec.cc")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 
 _lock = threading.Lock()
@@ -24,15 +25,21 @@ _cached: dict = {}
 _load_failed: set = set()
 
 
-def _so_path(src: str, stem: str) -> str:
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_BUILD_DIR, f"lib{stem}_{digest}.so")
+def _so_path(src: str, stem: str, deps: tuple = ()) -> str:
+    """Cache key: the .so name carries a hash of the source AND every
+    #include'd sibling, so editing either triggers exactly one rebuild
+    and an unchanged tree never recompiles across test sessions."""
+    h = hashlib.sha256()
+    for path in (src,) + deps:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_BUILD_DIR, f"lib{stem}_{h.hexdigest()[:16]}.so")
 
 
-def _build_src(src: str, stem: str, verbose: bool = False) -> str:
+def _build_src(src: str, stem: str, verbose: bool = False,
+               deps: tuple = ()) -> str:
     """Compile one source if needed; returns the .so path."""
-    so = _so_path(src, stem)
+    so = _so_path(src, stem, deps)
     if os.path.exists(so):
         return so
     os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -51,14 +58,15 @@ def build(verbose: bool = False) -> str:
     return _build_src(_SRC, "cadence_packer", verbose)
 
 
-def _load_lib(src: str, stem: str, configure) -> Optional[ctypes.CDLL]:
+def _load_lib(src: str, stem: str, configure,
+              deps: tuple = ()) -> Optional[ctypes.CDLL]:
     with _lock:
         if stem in _cached:
             return _cached[stem]
         if stem in _load_failed:
             return None
         try:
-            lib = ctypes.CDLL(_build_src(src, stem))
+            lib = ctypes.CDLL(_build_src(src, stem, deps=deps))
         except (OSError, subprocess.CalledProcessError, FileNotFoundError):
             _load_failed.add(stem)
             return None
@@ -91,6 +99,74 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,                   # num_threads
         ]
     return _load_lib(_SRC, "cadence_packer", configure)
+
+
+def wirec_cached() -> bool:
+    """True when the native wirec .so is ALREADY BUILT for the current
+    sources — a file-hash probe that never shells out to the compiler,
+    so boot paths (ServiceHost gauge pre-registration) can report
+    availability without blocking startup on a g++ run."""
+    try:
+        return os.path.exists(_so_path(_SRC_WIREC, "cadence_wirec",
+                                       deps=(_SRC,)))
+    except OSError:
+        return False
+
+
+def load_wirec() -> Optional[ctypes.CDLL]:
+    """Load the native wirec encoder (wirec.cc includes packer.cc, so
+    the cache digest spans both); None without a toolchain."""
+    I64P = ctypes.POINTER(ctypes.c_int64)
+    U8P = ctypes.POINTER(ctypes.c_uint8)
+    I32P = ctypes.POINTER(ctypes.c_int32)
+
+    def configure(lib):
+        # packer.cc rides inside wirec.cc, so its corpus entry point is
+        # exported from this .so too — declare the 64-bit ABI here as
+        # well (ctypes defaults would truncate the int64 args/return)
+        lib.cadence_pack_corpus.restype = ctypes.c_int64
+        lib.cadence_pack_corpus.argtypes = [
+            ctypes.c_char_p, I64P,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            I64P, ctypes.c_int64,
+        ]
+        lib.cadence_wirec_measure.restype = ctypes.c_int64
+        lib.cadence_wirec_measure.argtypes = [
+            I64P,                             # lanes [W, E, L]
+            ctypes.c_int64,                   # W
+            ctypes.c_int64,                   # E
+            ctypes.c_int64,                   # L
+            I64P, I64P, I64P, I64P,           # kinds/widths/scales/consts
+            ctypes.c_int64,                   # num_threads
+        ]
+        lib.cadence_wirec_emit.restype = ctypes.c_int64
+        lib.cadence_wirec_emit.argtypes = [
+            I64P,                             # lanes
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # W, E, L
+            I64P, I64P, I64P, I64P, I64P, I64P, I64P,  # profile columns
+            ctypes.c_int64,                   # P
+            ctypes.c_int64, ctypes.c_int64,   # B, K
+            U8P,                              # slab [W, E, B]
+            I64P,                             # bases [W, K]
+            I32P,                             # n_events [W]
+            ctypes.c_int64,                   # num_threads
+        ]
+        lib.cadence_wirec_pack_fused.restype = ctypes.c_int64
+        lib.cadence_wirec_pack_fused.argtypes = [
+            ctypes.c_char_p,                  # blob
+            I64P,                             # offsets [W + 1]
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # W, E, L
+            I64P,                             # lanes scratch [W, E, L]
+            I64P, I64P, I64P, I64P, I64P, I64P, I64P,  # profile columns
+            ctypes.c_int64,                   # P
+            ctypes.c_int64, ctypes.c_int64,   # B, K
+            U8P,                              # slab
+            I64P,                             # bases
+            I32P,                             # n_events
+            I64P,                             # misfit_out [1]
+            ctypes.c_int64,                   # num_threads
+        ]
+    return _load_lib(_SRC_WIREC, "cadence_wirec", configure, deps=(_SRC,))
 
 
 def load_generator() -> Optional[ctypes.CDLL]:

@@ -17,7 +17,6 @@ packer's standalone rate so the pipeline's overhead is always measured.
 """
 from __future__ import annotations
 
-import os
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -48,6 +47,11 @@ class FeedReport:
     compress_s: float = 0.0
     wire_bytes: int = 0
     profile_refits: int = 0
+    #: which encoder packed the chunks (native C++ fused pass vs the
+    #: byte-identical pure-Python path) and what the staged host→device
+    #: handoff cost — the pinned-buffer H2D seconds bench records
+    native_wirec: bool = False
+    h2d_s: float = 0.0
 
     @property
     def events_per_sec(self) -> float:
@@ -193,36 +197,64 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
                           chunk_workflows: int = 4096,
                           layout: PayloadLayout = DEFAULT_LAYOUT,
                           num_threads: Optional[int] = None,
-                          depth: Optional[int] = None, mesh=None
+                          depth: Optional[int] = None, mesh=None,
+                          registry=None
                           ) -> Tuple[np.ndarray, np.ndarray, FeedReport]:
-    """The COMPRESSED ingest pipeline: wire bytes → C++ int64 packer →
-    numpy wirec compression (~10-18 B/event, ops/wirec.py) → H2D → device
+    """The COMPRESSED ingest pipeline: wire bytes → wirec adaptive-
+    columnar buffers (~10-18 B/event, ops/wirec.py) → H2D → device
     decode+replay+checksum → 4 bytes/workflow back.
+
+    Two host encoders serve the pack stage, byte-identical by contract
+    (tests/test_native_packer.py fuzzes the parity): the NATIVE pipeline
+    (native/wirec.cc via CADENCE_TPU_NATIVE_WIREC, default on when the
+    .so is loadable) runs wire blobs → int64 lanes → wirec buffers in
+    ONE multi-threaded C++ call per chunk, staging straight into
+    preallocated ring-slot buffers (WirecBuffers — zero Python-side
+    allocation per chunk) that hand off to the device through
+    stage_corpus (dlpack where the backend accepts it); the pure-Python
+    fallback is the original pack_serialized + pack_wirec pair. Which
+    encoder served is a /metrics scrape (tpu.native/*) and rides the
+    report's native_wirec flag.
 
     The wirec profile is measured on the FIRST chunk and pinned so every
     chunk shares one executable; a later chunk whose values fall outside
     the pinned widths triggers a refit (recompute + recompile, and the
     refreshed plan becomes the pin for chunks packed after it) — counted
-    in the report, never silent. Compression runs chunk-parallel inside
-    the pack pool (pack_wirec's num_threads path), so host packing scales
-    with cores instead of pinning one."""
+    in the report, never silent. Both encoders measure profiles with the
+    identical decision procedure, so pin/refit behavior cannot depend on
+    which one served."""
     import jax
 
     from ..ops.replay import replay_wirec_to_crc
     from ..ops.wirec import ProfileMisfit, pack_wirec
+    from ..utils.concurrency import pack_threads
+    from . import wirec as nwirec
 
     mesh = _resolve_mesh(mesh)
     chunk_workflows = _mesh_chunk(chunk_workflows, mesh)
     total = len(blobs)
-    executor = BulkReplayExecutor(depth=depth, mesh=mesh)
-    report = FeedReport(workflows=total, depth=executor.depth)
+    registry = registry if registry is not None else m.DEFAULT_REGISTRY
+    executor = BulkReplayExecutor(depth=depth, mesh=mesh,
+                                  registry=registry)
+    use_native = nwirec.wirec_native_enabled(registry)
+    report = FeedReport(workflows=total, depth=executor.depth,
+                        native_wirec=use_native)
     prof = ReplayProfiler()
-    buffers = [np.empty((chunk_workflows, max_events, packing.NUM_LANES),
-                        dtype=np.int64) for _ in range(executor.depth)]
     n_chunks = -(-total // chunk_workflows) if total else 0
-    # intra-chunk wirec threads: split the cores across the pack pool
+    # intra-chunk wirec threads: the one CADENCE_TPU_PACK_THREADS knob,
+    # split across the pack pool's concurrent workers
     wirec_threads = (num_threads if num_threads is not None
-                     else max(1, (os.cpu_count() or 2) // executor.depth))
+                     else max(1, pack_threads() // executor.depth))
+    if use_native:
+        # reusable staging: lanes scratch + wirec output triple per ring
+        # slot, fully overwritten by every emit (no zeroing, no per-chunk
+        # allocation) — the pinned host buffers the H2D stages from
+        buffers = [nwirec.WirecBuffers(chunk_workflows, max_events)
+                   for _ in range(executor.depth)]
+    else:
+        buffers = [np.empty((chunk_workflows, max_events,
+                             packing.NUM_LANES), dtype=np.int64)
+                   for _ in range(executor.depth)]
 
     # chunk 0 measures the profile; later pack tasks pin the latest plan
     # (a refit replaces it under the lock)
@@ -230,40 +262,80 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
     state_lock = Lock()
     shared = {"profile": None, "refits": 0,
               "pack_s": 0.0, "compress_s": 0.0,
-              "events": 0, "wire_bytes": 0}
+              "events": 0, "wire_bytes": 0, "h2d_s": 0.0}
 
-    def pack(ci):
-        chunk = _chunk_blobs(blobs, ci * chunk_workflows, chunk_workflows)
-        t0 = time.perf_counter()
+    def _encode_native(ci, chunk, slot):
+        """Fused native chunk: blobs → lanes → wirec in one ctypes call
+        (decode + compress are one pass, so pack_s carries the whole
+        host cost and compress_s stays 0)."""
+        if ci == 0:
+            corpus, _ = nwirec.pack_serialized_wirec(
+                chunk, max_events, num_threads=wirec_threads, out=slot)
+            with state_lock:
+                shared["profile"] = corpus.profile
+            first_profile.set_result(corpus.profile)
+            return corpus, 0.0
+        first_profile.result()
+        with state_lock:
+            pinned = shared["profile"]
+        try:
+            corpus, _ = nwirec.pack_serialized_wirec(
+                chunk, max_events, profile=pinned,
+                num_threads=wirec_threads, out=slot)
+        except ProfileMisfit:
+            # refit: fresh plan, recompile; later chunks pin it. The
+            # fused call decodes blobs into the slot's lanes scratch
+            # BEFORE reporting the emit misfit, so re-measure + emit
+            # from those lanes instead of re-decoding the wire bytes
+            corpus = nwirec.pack_wirec_native(
+                slot.lanes, num_threads=wirec_threads, out=slot)
+            with state_lock:
+                shared["profile"] = corpus.profile
+                shared["refits"] += 1
+        return corpus, 0.0
+
+    def _encode_python(ci, chunk, slot):
         packed = packing.pack_serialized(chunk, max_events,
                                          num_threads=num_threads,
-                                         out=buffers[ci % executor.depth])
-        pack_dt = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        try:
-            if ci == 0:
+                                         out=slot)
+        t1 = time.perf_counter()
+        if ci == 0:
+            corpus = pack_wirec(packed, num_threads=wirec_threads)
+            with state_lock:
+                shared["profile"] = corpus.profile
+            first_profile.set_result(corpus.profile)
+        else:
+            first_profile.result()
+            with state_lock:
+                pinned = shared["profile"]
+            try:
+                corpus = pack_wirec(packed, profile=pinned,
+                                    num_threads=wirec_threads)
+            except ProfileMisfit:
+                # refit: fresh plan, recompile; later chunks pin it
                 corpus = pack_wirec(packed, num_threads=wirec_threads)
                 with state_lock:
                     shared["profile"] = corpus.profile
-                first_profile.set_result(corpus.profile)
+                    shared["refits"] += 1
+        return corpus, time.perf_counter() - t1
+
+    def pack(ci):
+        chunk = _chunk_blobs(blobs, ci * chunk_workflows, chunk_workflows)
+        slot = buffers[ci % executor.depth]
+        t0 = time.perf_counter()
+        try:
+            if use_native:
+                corpus, compress_dt = _encode_native(ci, chunk, slot)
             else:
-                first_profile.result()
-                with state_lock:
-                    pinned = shared["profile"]
-                try:
-                    corpus = pack_wirec(packed, profile=pinned,
-                                        num_threads=wirec_threads)
-                except ProfileMisfit:
-                    # refit: fresh plan, recompile; later chunks pin it
-                    corpus = pack_wirec(packed, num_threads=wirec_threads)
-                    with state_lock:
-                        shared["profile"] = corpus.profile
-                        shared["refits"] += 1
+                corpus, compress_dt = _encode_python(ci, chunk, slot)
         except BaseException as exc:
             if ci == 0 and not first_profile.done():
                 first_profile.set_exception(exc)
             raise
-        compress_dt = time.perf_counter() - t0
+        pack_dt = time.perf_counter() - t0 - compress_dt
+        registry.inc(m.SCOPE_TPU_NATIVE,
+                     m.M_NATIVE_PACKS if use_native
+                     else m.M_NATIVE_PY_PACKS)
         with state_lock:
             shared["pack_s"] += pack_dt
             shared["compress_s"] += compress_dt
@@ -276,13 +348,14 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
 
     def launch(ci, corpus):
         with prof.leg(m.M_PROFILE_H2D):
+            t0 = time.perf_counter()
             if mesh is not None:
                 from ..parallel.mesh import shard_wirec
                 parts = shard_wirec(corpus, mesh)
             else:
-                parts = (jax.device_put(corpus.slab),
-                         jax.device_put(corpus.bases),
-                         jax.device_put(corpus.n_events))
+                parts = nwirec.stage_corpus(corpus)
+            with state_lock:
+                shared["h2d_s"] += time.perf_counter() - t0
             prof.h2d(corpus.wire_bytes)
         return replay_wirec_to_crc(*parts, corpus.profile, layout)
 
@@ -303,6 +376,7 @@ def feed_serialized_wirec(blobs: Sequence[bytes], max_events: int,
     report.events = shared["events"]
     report.wire_bytes = shared["wire_bytes"]
     report.profile_refits = shared["refits"]
+    report.h2d_s = shared["h2d_s"]
     report.wall_s = time.perf_counter() - start
     return first, errors, report
 
@@ -335,6 +409,52 @@ def feed_corpus32(histories, chunk_workflows: int = 4096,
         max_events = max(history_length(h) for h in histories)
     return feed_serialized32(serialize_corpus(histories), max_events,
                              chunk_workflows, layout, depth=depth, mesh=mesh)
+
+
+def feed_appends(items, resident_cache, pack_cache
+                 ) -> Tuple[list, FeedReport]:
+    """The SUFFIX-APPEND ingest path: the feeder twin of an append/
+    re-verify transaction stream. Each item is (workflow key, CURRENT
+    batches); suffix lanes come from engine/cache.PackCache.encode_suffix
+    — the resumed-interner suffix repack, O(new events) host cost,
+    byte-identical to the matching slice of a cold pack — and replay
+    against the HBM-resident states through the pipelined executor
+    (engine/resident.ResidentStateCache.replay_append): chunk shapes are
+    sized by the longest SUFFIX, so an append stream costs by appended
+    events, never history length (gated in test_perf_gate.py
+    TestFeederGate).
+
+    Returns (one AppendResult per item — exact hits served from the
+    resident payload without touching the device, misses ok=False for
+    the caller's cold full-replay path — , FeedReport whose events/
+    events_per_sec count APPENDED events only)."""
+    from ..engine.resident import AppendResult
+
+    t_start = time.perf_counter()
+    results: List[Optional[AppendResult]] = [None] * len(items)
+    suffix_items, suffix_pos = [], []
+    for i, (key, batches) in enumerate(items):
+        hit = resident_cache.lookup(key, batches)
+        if hit is None:
+            results[i] = AppendResult(ok=False)
+        elif hit[0] == "exact":
+            entry = hit[1]
+            results[i] = AppendResult(ok=True, payload=entry.payload,
+                                      branch=entry.branch, rung=entry.rung)
+        else:
+            suffix_pos.append(i)
+            suffix_items.append((key, hit[1], batches))
+    events = chunks = 0
+    if suffix_items:
+        outs, append_report = resident_cache.replay_append_report(
+            suffix_items, encode_suffix=pack_cache.encode_suffix)
+        for i, res in zip(suffix_pos, outs):
+            results[i] = res
+        events = append_report.events_appended
+        chunks = len(append_report.chunk_shapes)
+    return results, FeedReport(workflows=len(items), events=events,
+                               chunks=chunks,
+                               wall_s=time.perf_counter() - t_start)
 
 
 def feed_corpus_wirec(histories, chunk_workflows: int = 4096,

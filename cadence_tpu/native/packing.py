@@ -2,17 +2,41 @@
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..ops.encode import NUM_LANES
+from ..utils.concurrency import pack_threads
 from . import build as _build
 
 
 def native_available() -> bool:
     return _build.load() is not None
+
+
+def blob_offsets(blobs: Sequence[bytes]):
+    """Join W serialized histories into the (blob, offsets[W + 1]) call
+    frame every native corpus entry point takes — ONE implementation so
+    the packer ABI has a single Python-side counterpart."""
+    blob = b"".join(blobs)
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return blob, offsets
+
+
+def raise_pack_error(rc: int, wire32: bool = False) -> None:
+    """Decode a native packer failure (-(workflow+1)*1000 - err) into
+    the typed ValueError — shared by every caller of the corpus entry
+    points so the error-code table can't drift per call site."""
+    workflow = (-rc) // 1000 - 1
+    err = (-rc) % 1000
+    codes = ("1=truncated, 2=unknown attr, 3=history exceeds max_events"
+             + (", 4=lane exceeds int32 — use the int64 path"
+                if wire32 else ""))
+    raise ValueError(
+        f"native packer failed on workflow {workflow} (code {err}: "
+        f"{codes})")
 
 
 def pack_serialized(blobs: Sequence[bytes], max_events: int,
@@ -26,12 +50,9 @@ def pack_serialized(blobs: Sequence[bytes], max_events: int,
     lib = _build.load()
     if lib is None:
         raise RuntimeError("native packer unavailable (no C++ toolchain)")
-    if num_threads is None:
-        num_threads = min(len(blobs), os.cpu_count() or 1)
+    num_threads = pack_threads(num_threads, cap=max(1, len(blobs)))
     W = len(blobs)
-    blob = b"".join(blobs)
-    offsets = np.zeros(W + 1, dtype=np.int64)
-    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    blob, offsets = blob_offsets(blobs)
     if out is None:
         out = np.empty((W, max_events, NUM_LANES), dtype=np.int64)
     else:
@@ -44,12 +65,7 @@ def pack_serialized(blobs: Sequence[bytes], max_events: int,
         num_threads,
     )
     if rc < 0:
-        workflow = (-rc) // 1000 - 1
-        err = (-rc) % 1000
-        raise ValueError(
-            f"native packer failed on workflow {workflow} (code {err}: "
-            f"1=truncated, 2=unknown attr, 3=history exceeds max_events)"
-        )
+        raise_pack_error(rc)
     return out
 
 
@@ -65,12 +81,9 @@ def pack_serialized32(blobs: Sequence[bytes], max_events: int,
     lib = _build.load()
     if lib is None:
         raise RuntimeError("native packer unavailable (no C++ toolchain)")
-    if num_threads is None:
-        num_threads = min(len(blobs), os.cpu_count() or 1)
+    num_threads = pack_threads(num_threads, cap=max(1, len(blobs)))
     W = len(blobs)
-    blob = b"".join(blobs)
-    offsets = np.zeros(W + 1, dtype=np.int64)
-    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    blob, offsets = blob_offsets(blobs)
     if out is None:
         out = np.empty((W, max_events, NUM_LANES32), dtype=np.int32)
     else:
@@ -83,13 +96,7 @@ def pack_serialized32(blobs: Sequence[bytes], max_events: int,
         num_threads,
     )
     if rc < 0:
-        workflow = (-rc) // 1000 - 1
-        err = (-rc) % 1000
-        raise ValueError(
-            f"native packer failed on workflow {workflow} (code {err}: "
-            f"1=truncated, 2=unknown attr, 3=history exceeds max_events, "
-            f"4=lane exceeds int32 — use the int64 path)"
-        )
+        raise_pack_error(rc, wire32=True)
     return out
 
 

@@ -1,0 +1,317 @@
+"""Native wirec pipeline: ctypes binding, reusable staging buffers, and
+the native/Python dispatcher every wirec-packing hot path routes through.
+
+BENCH_r05: device replay sustains ~3.9M events/s transfer-included while
+the streaming feeder sustains ~622k — the numpy wirec emit is the
+production bottleneck. `wirec.cc` ports measure/emit to C++ (threaded,
+byte-identical, same ProfileMisfit refit contract) and adds a FUSED
+entry point: wire blobs → int64 lanes → wirec adaptive-columnar buffers
+in one multi-threaded call, writing into preallocated reusable host
+buffers sized to the feeder's ring slots so a streaming chunk costs zero
+Python-side allocation or copies before the single H2D transfer.
+
+Path selection: `CADENCE_TPU_NATIVE_WIREC` (default ON when the .so is
+loadable, any of 0/false/off forces the pure-Python path; the fallback
+is byte-identical, it is only slower). The `tpu.native/available` gauge
+plus native-packs/python-packs counters say which encoder actually
+served, so "which path ran" is a /metrics scrape, never a guess.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.encode import NUM_LANES
+from ..ops.wirec import (
+    KIND_DELTA,
+    KIND_TSREL_NZ,
+    LaneCode,
+    ProfileMisfit,
+    WirecCorpus,
+    pack_wirec,
+)
+from ..utils import metrics as m
+from ..utils.concurrency import pack_threads
+from . import build as _build
+
+#: the native-wirec knob: default on when the .so is available;
+#: 0/false/off pins the byte-identical pure-Python encoder
+NATIVE_WIREC_ENV = "CADENCE_TPU_NATIVE_WIREC"
+
+#: host→device staging knob: default on — reusable staging buffers hand
+#: off through dlpack where the backend accepts it (on the CPU backend
+#: this halves the measured H2D cost vs device_put of the same buffer);
+#: 0/false/off pins plain jax.device_put
+ZERO_COPY_ENV = "CADENCE_TPU_ZERO_COPY"
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def native_wirec_available() -> bool:
+    return _build.load_wirec() is not None
+
+
+def wirec_native_enabled(registry=None) -> bool:
+    """True when wirec packs should take the native encoder. Publishes
+    the `tpu.native/available` gauge as a side effect — the scrape-level
+    answer to "did this process ever have the fast path at all"."""
+    reg = registry if registry is not None else m.DEFAULT_REGISTRY
+    avail = native_wirec_available()
+    reg.scope(m.SCOPE_TPU_NATIVE).gauge(m.M_NATIVE_AVAILABLE,
+                                        1.0 if avail else 0.0)
+    env = os.environ.get(NATIVE_WIREC_ENV, "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    return avail
+
+
+#: None = undecided; set once on the first staging attempt so a backend
+#: that rejects dlpack imports costs ONE failed try, not one per chunk
+_DLPACK_OK: Optional[bool] = None
+
+
+def stage_h2d(arr):
+    """ONE host→device staging hop for a reusable pinned host buffer.
+
+    dlpack import when the backend accepts it (the fast path — the
+    buffer's memory is handed to the runtime without a Python-side
+    copy), jax.device_put otherwise. A numpy buffer always imports as a
+    kDLCPU tensor, so on a non-CPU default backend (TPU/GPU) the import
+    "succeeds" but lands on the wrong device and every downstream jit
+    would reject it — the first call checks placement against the
+    default device and pins device_put for the process when it doesn't
+    match. Safe against ring-slot reuse either way: the executor's ring
+    discipline frees a slot only after the chunk that last used it has
+    fully replayed, so the device is never still reading a buffer being
+    overwritten."""
+    global _DLPACK_OK
+    import jax
+
+    env = os.environ.get(ZERO_COPY_ENV, "").strip().lower()
+    if env not in ("0", "false", "off", "no") and _DLPACK_OK is not False:
+        try:
+            out = jax.dlpack.from_dlpack(arr)
+            if _DLPACK_OK is None:
+                _DLPACK_OK = next(iter(out.devices())) == jax.devices()[0]
+            if _DLPACK_OK:
+                return out
+        except Exception:
+            _DLPACK_OK = False
+    return jax.device_put(arr)
+
+
+def stage_corpus(corpus: WirecCorpus):
+    """Stage a wirec triple for a single-device launch (the feeder's
+    non-mesh hot path); returns (slab, bases, n_events) device arrays."""
+    return (stage_h2d(corpus.slab), stage_h2d(corpus.bases),
+            stage_h2d(corpus.n_events))
+
+
+def _assemble_profile(plans) -> Tuple[LaneCode, ...]:
+    """(kind, width, scale, const) per lane → LaneCode tuple — the EXACT
+    offset/base-column assembly loop of ops.wirec.pack_wirec, so the
+    profile structure cannot drift between the two encoders."""
+    off = 0
+    base_cols = 0
+    entries = []
+    for lane, (kind, width, scale, const) in enumerate(plans):
+        bi = -1
+        if kind in (KIND_DELTA, KIND_TSREL_NZ):
+            bi = base_cols
+            base_cols += 1
+        entries.append(LaneCode(lane, kind, off if width else 0,
+                                width, scale, const, bi))
+        off += width
+    return tuple(entries)
+
+
+def _profile_columns(profile):
+    cols = []
+    for field in ("lane", "kind", "offset", "width", "scale", "const",
+                  "base_index"):
+        cols.append(np.fromiter((getattr(e, field) for e in profile),
+                                dtype=np.int64, count=len(profile)))
+    return cols
+
+
+def _col_ptrs(cols):
+    return [c.ctypes.data_as(_I64P) for c in cols]
+
+
+def profile_widths(profile) -> Tuple[int, int]:
+    """(B, K): slab bytes per event and bases columns under `profile`."""
+    return (sum(e.width for e in profile),
+            sum(1 for e in profile if e.base_index >= 0))
+
+
+def _raise_misfit(code: int) -> None:
+    lane, reason = divmod(code - 1000, 4)
+    what = {0: "non-const under CONST", 1: "scale misfit",
+            2: "width overflow"}.get(reason, f"code {reason}")
+    raise ProfileMisfit(f"lane {lane}: {what} (native)")
+
+
+class WirecBuffers:
+    """Preallocated reusable host staging for ONE ring slot of the
+    streaming pipeline: the int64 lanes scratch plus the wirec output
+    triple (slab/bases/n_events), lazily (re)sized when the pinned
+    profile's slab width changes (a refit event — rare by design).
+
+    The native emit fully overwrites every byte it hands out, so slots
+    are reused chunk after chunk with no zeroing; the executor's ring
+    discipline guarantees the device consumed a slot's H2D copy before
+    the slot is written again."""
+
+    def __init__(self, chunk_workflows: int, max_events: int) -> None:
+        self.W = chunk_workflows
+        self.E = max_events
+        self.lanes = np.empty((chunk_workflows, max_events, NUM_LANES),
+                              dtype=np.int64)
+        self._key: Optional[Tuple[int, int]] = None
+        self.slab = self.bases = self.n_events = None
+
+    def for_profile(self, profile):
+        B, K = profile_widths(profile)
+        if self._key != (B, K):
+            self.slab = np.empty((self.W, self.E, B), dtype=np.uint8)
+            self.bases = np.empty((self.W, K), dtype=np.int64)
+            self.n_events = np.empty((self.W,), dtype=np.int32)
+            self._key = (B, K)
+        return self.slab, self.bases, self.n_events
+
+
+def measure_profile_native(events64: np.ndarray,
+                           num_threads: Optional[int] = None
+                           ) -> Tuple[LaneCode, ...]:
+    """Per-lane plan of a [W, E, L] int64 tensor — the native twin of
+    pack_wirec's profile measurement (identical decision procedure)."""
+    lib = _build.load_wirec()
+    if lib is None:
+        raise RuntimeError("native wirec unavailable (no C++ toolchain)")
+    ev = np.ascontiguousarray(events64, dtype=np.int64)
+    W, E, L = ev.shape
+    assert L == NUM_LANES, f"expected {NUM_LANES} lanes, got {L}"
+    kinds, widths, scales, consts = (np.zeros(L, dtype=np.int64)
+                                     for _ in range(4))
+    rc = lib.cadence_wirec_measure(
+        ev.ctypes.data_as(_I64P), W, E, L,
+        kinds.ctypes.data_as(_I64P), widths.ctypes.data_as(_I64P),
+        scales.ctypes.data_as(_I64P), consts.ctypes.data_as(_I64P),
+        pack_threads(num_threads, cap=L))
+    assert rc == 0, rc
+    return _assemble_profile(list(zip(kinds.tolist(), widths.tolist(),
+                                      scales.tolist(), consts.tolist())))
+
+
+def pack_wirec_native(events64: np.ndarray,
+                      profile=None,
+                      num_threads: Optional[int] = None,
+                      out: Optional[WirecBuffers] = None) -> WirecCorpus:
+    """Native [W, E, L] int64 → WirecCorpus, byte-identical to
+    ops.wirec.pack_wirec (same profile measurement when `profile` is
+    None; ProfileMisfit under a pinned profile whose widths/scales the
+    chunk exceeds). `out` stages into a reusable WirecBuffers slot."""
+    lib = _build.load_wirec()
+    if lib is None:
+        raise RuntimeError("native wirec unavailable (no C++ toolchain)")
+    ev = np.ascontiguousarray(events64, dtype=np.int64)
+    W, E, L = ev.shape
+    assert L == NUM_LANES, f"expected {NUM_LANES} lanes, got {L}"
+    threads = pack_threads(num_threads)
+    if profile is None:
+        profile = measure_profile_native(ev, num_threads=threads)
+    B, K = profile_widths(profile)
+    if out is not None:
+        assert (out.W, out.E) == (W, E), ((out.W, out.E), (W, E))
+        slab, bases, n_events = out.for_profile(profile)
+    else:
+        slab = np.empty((W, E, B), dtype=np.uint8)
+        bases = np.empty((W, K), dtype=np.int64)
+        n_events = np.empty((W,), dtype=np.int32)
+    rc = lib.cadence_wirec_emit(
+        ev.ctypes.data_as(_I64P), W, E, L,
+        *_col_ptrs(_profile_columns(profile)), len(profile), B, K,
+        slab.ctypes.data_as(_U8P), bases.ctypes.data_as(_I64P),
+        n_events.ctypes.data_as(_I32P), threads)
+    if rc != 0:
+        _raise_misfit(rc)
+    return WirecCorpus(slab, bases, n_events, profile)
+
+
+def pack_serialized_wirec(blobs: Sequence[bytes], max_events: int,
+                          profile=None,
+                          num_threads: Optional[int] = None,
+                          out: Optional[WirecBuffers] = None
+                          ) -> Tuple[WirecCorpus, int]:
+    """The fused streaming chunk: W serialized histories → int64 lanes →
+    wirec buffers in ONE native call (pinned profile) or one pack +
+    measure + emit pass (first chunk). Returns (corpus, total events);
+    raises ProfileMisfit when the chunk falls outside a pinned profile
+    (the caller refits, exactly like the numpy path)."""
+    from .packing import blob_offsets, raise_pack_error
+
+    lib = _build.load_wirec()
+    if lib is None:
+        raise RuntimeError("native wirec unavailable (no C++ toolchain)")
+    W = len(blobs)
+    blob, offsets = blob_offsets(blobs)
+    threads = pack_threads(num_threads, cap=max(1, W))
+    if out is not None:
+        assert (out.W, out.E) == (W, max_events)
+        lanes = out.lanes
+    else:
+        lanes = np.empty((W, max_events, NUM_LANES), dtype=np.int64)
+
+    if profile is None:
+        rc = lib.cadence_pack_corpus(
+            blob, offsets.ctypes.data_as(_I64P), W, max_events, NUM_LANES,
+            lanes.ctypes.data_as(_I64P), threads)
+        if rc < 0:
+            raise_pack_error(rc)
+        corpus = pack_wirec_native(lanes, num_threads=num_threads, out=out)
+        return corpus, int(rc)
+
+    B, K = profile_widths(profile)
+    if out is not None:
+        slab, bases, n_events = out.for_profile(profile)
+    else:
+        slab = np.empty((W, max_events, B), dtype=np.uint8)
+        bases = np.empty((W, K), dtype=np.int64)
+        n_events = np.empty((W,), dtype=np.int32)
+    misfit = np.zeros(1, dtype=np.int64)
+    rc = lib.cadence_wirec_pack_fused(
+        blob, offsets.ctypes.data_as(_I64P), W, max_events, NUM_LANES,
+        lanes.ctypes.data_as(_I64P),
+        *_col_ptrs(_profile_columns(profile)), len(profile), B, K,
+        slab.ctypes.data_as(_U8P), bases.ctypes.data_as(_I64P),
+        n_events.ctypes.data_as(_I32P), misfit.ctypes.data_as(_I64P),
+        threads)
+    if rc < 0:
+        raise_pack_error(rc)
+    if int(misfit[0]) != 0:
+        _raise_misfit(int(misfit[0]))
+    return WirecCorpus(slab, bases, n_events, profile), int(rc)
+
+
+def pack_wirec_auto(events64: np.ndarray, profile=None,
+                    num_threads: Optional[int] = None,
+                    registry=None) -> WirecCorpus:
+    """The ONE wirec-pack dispatcher the hot paths call (feeder,
+    executor streaming, resident appends, bench): native encoder when
+    enabled+available, byte-identical pure-Python otherwise. Counts
+    which encoder served under tpu.native/*. ProfileMisfit propagates
+    from either side — the refit contract is path-independent."""
+    reg = registry if registry is not None else m.DEFAULT_REGISTRY
+    if wirec_native_enabled(reg):
+        corpus = pack_wirec_native(events64, profile=profile,
+                                   num_threads=num_threads)
+        reg.inc(m.SCOPE_TPU_NATIVE, m.M_NATIVE_PACKS)
+        return corpus
+    corpus = pack_wirec(events64, profile=profile, num_threads=num_threads)
+    reg.inc(m.SCOPE_TPU_NATIVE, m.M_NATIVE_PY_PACKS)
+    return corpus

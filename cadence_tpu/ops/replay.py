@@ -241,6 +241,25 @@ def replay_wirec_from_state(slab: jnp.ndarray, bases: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("profile", "out_layout"))
+def replay_wirec_from_state_to_payload(slab: jnp.ndarray,
+                                       bases: jnp.ndarray,
+                                       n_events: jnp.ndarray, profile,
+                                       s0: ReplayState,
+                                       out_layout: PayloadLayout
+                                       = DEFAULT_LAYOUT):
+    """wirec from-state replay reduced to the serving shape: (final
+    state, payload rows at `out_layout` width, error [W],
+    narrow_overflow [W]) — the compressed-transfer twin of
+    replay_from_state_to_payload, so the resident append path ships
+    ~10-18 B/event of suffix instead of 144 dense bytes."""
+    from .payload import payload_rows_narrow
+
+    s = replay_wirec_from_state(slab, bases, n_events, profile, s0)
+    rows, ovf = payload_rows_narrow(s, out_layout)
+    return s, rows, s.error, ovf
+
+
+@partial(jax.jit, static_argnames=("profile", "out_layout"))
 def replay_wirec_from_state_to_crc(slab: jnp.ndarray, bases: jnp.ndarray,
                                    n_events: jnp.ndarray, profile,
                                    s0: ReplayState,

@@ -252,8 +252,12 @@ def pack_wirec(events64: np.ndarray,
     transform — delta, GCD scaling, ts-rel — is per-workflow, so blocks
     are independent and the packed bytes are identical to the serial
     path). numpy releases the GIL inside the ufunc loops, so host packing
-    scales with cores instead of pinning one.
+    scales with cores instead of pinning one. `None` resolves through the
+    one CADENCE_TPU_PACK_THREADS knob (utils/concurrency.pack_threads);
+    small corpora stay serial either way (_MIN_BLOCK_ROWS).
     """
+    from ..utils.concurrency import pack_threads
+
     ev = np.asarray(events64, dtype=np.int64)
     W, E, L = ev.shape
     assert L == NUM_LANES, f"expected {NUM_LANES} lanes, got {L}"
@@ -262,7 +266,7 @@ def pack_wirec(events64: np.ndarray,
     # row 0 is real whenever n > 0, so the first-row value IS the base
     ts_base = ev[:, 0, LANE_TIMESTAMP]
 
-    threads = 1 if num_threads is None else max(1, int(num_threads))
+    threads = pack_threads(num_threads)
     if W < 2 * _MIN_BLOCK_ROWS:
         threads = 1
     pool = _pack_pool(threads) if threads > 1 else None

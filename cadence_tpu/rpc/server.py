@@ -214,6 +214,17 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         for gauge in (cm.M_RESIDENT_BYTES, cm.M_RESIDENT_ENTRIES,
                       cm.M_RESIDENT_BUDGET_BYTES):
             self.metrics.gauge(cm.SCOPE_TPU_RESIDENT, gauge, 0.0)
+        # native-encoder series: the availability gauge answers "does
+        # THIS process have the compiled fast path" on every scrape.
+        # Boot publishes from the build-cache PROBE (file-hash check,
+        # never a compiler run — a fresh box must not block startup on
+        # g++); the first wirec pack through this registry re-publishes
+        # the live value. Pack counters start visible at zero.
+        from ..native import build as native_build
+        self.metrics.gauge(cm.SCOPE_TPU_NATIVE, cm.M_NATIVE_AVAILABLE,
+                           1.0 if native_build.wirec_cached() else 0.0)
+        self.metrics.inc(cm.SCOPE_TPU_NATIVE, cm.M_NATIVE_PACKS, 0)
+        self.metrics.inc(cm.SCOPE_TPU_NATIVE, cm.M_NATIVE_PY_PACKS, 0)
         # mesh-aware executor series likewise pre-registered, with the
         # per-device labels the CADENCE_TPU_MESH_DEVICES knob implies
         # (the knob is parsed WITHOUT touching a JAX backend; "all"

@@ -46,6 +46,12 @@ SCOPE_TPU_RESIDENT = "tpu.resident"
 #: chunks-dispatched / pack-queue-wait / device-busy, with PER-DEVICE
 #: series (device_metric) when the executor runs over a mesh
 SCOPE_TPU_EXECUTOR = "tpu.executor"
+#: the native (C++) host-packing seam (native/packing.py + native/
+#: wirec.py): the `available` gauge says whether the compiled .so is
+#: loadable in THIS process (1) or every pack silently took the pure-
+#: Python path (0); native-packs / python-packs count which encoder
+#: actually served each wirec pack, so a scrape settles "which path ran"
+SCOPE_TPU_NATIVE = "tpu.native"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -148,6 +154,10 @@ M_EXEC_DEVICE_BUSY = "device-busy"
 #: limiter admitted vs shed (typed ServiceBusyError with retry-after)
 M_QUOTA_ADMITTED = "admitted"
 M_QUOTA_SHED = "shed"
+#: native-seam observability (SCOPE_TPU_NATIVE)
+M_NATIVE_AVAILABLE = "available"
+M_NATIVE_PACKS = "native-packs"
+M_NATIVE_PY_PACKS = "python-packs"
 
 
 def ladder_rung_rows(rung: int) -> str:

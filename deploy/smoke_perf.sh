@@ -28,6 +28,16 @@
 #     uses; CADENCE_TPU_MESH_DEVICES (default 8 here, default 1 in
 #     production serving — set it to shard the serving hot path across
 #     N devices) sizes it.
+#   - the FEEDER gate holds (TestFeederGate, ISSUE 9): the native-wirec
+#     feeder's sustained ingest rate stays within FEEDER_GATE_RATIO
+#     (default 0.5, i.e. within 2x) of the recorded device
+#     transfer-included rate, holds vs the baseline's feeder rate, the
+#     suffix-append leg costs by appended events, and a warm
+#     homogeneous stream provably compiles nothing new;
+#   - the pure-Python wirec fallback stays byte-identical: the full
+#     feeder + wirec test suites run AGAIN with the native encoder
+#     disabled (CADENCE_TPU_NATIVE_WIREC=0), so a native-only
+#     divergence can never hide behind the fast path.
 # The assertions live in tests/test_perf_gate.py, marked `perf`.
 #
 # Usage: deploy/smoke_perf.sh [baseline.json] [extra pytest args]
@@ -89,6 +99,12 @@ env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \
     XLA_FLAGS="--xla_force_host_platform_device_count=${MESH_N}" \
     JAX_PLATFORMS=cpu python -m pytest \
     tests/test_perf_gate.py::TestMeshGate -m perf -q
+
+# python-fallback parity: the whole feeder/wirec suite with the native
+# encoder pinned OFF — the byte-identical-fallback contract of ISSUE 9
+env CADENCE_TPU_NATIVE_WIREC=0 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_feeder.py tests/test_wirec.py \
+    tests/test_native_packer.py -q
 
 exec env PERF_CURRENT="$OUT" PERF_BASELINE="$BASELINE" \
     JAX_PLATFORMS=cpu python -m pytest tests/test_perf_gate.py \

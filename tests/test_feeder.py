@@ -130,3 +130,132 @@ class TestFeeder32:
         rows, _ = replay_to_payload(
             jnp.asarray(encode_corpus(hists, max_events)), DEFAULT_LAYOUT)
         assert (crcs == crc32_of_rows(np.asarray(rows))).all()
+
+
+@needs_native
+class TestFeederNativeWirec:
+    """The ISSUE 9 ingest path: the wirec feeder routed through the
+    native fused encoder must be CRC-identical to the pure-Python
+    fallback (CADENCE_TPU_NATIVE_WIREC=0), with the report saying which
+    encoder served and the profile pin surviving the whole stream."""
+
+    def _hists(self):
+        return generate_corpus("basic", num_workflows=48, seed=21,
+                               target_events=40)
+
+    def test_native_and_python_paths_crc_identical(self, monkeypatch):
+        from cadence_tpu.native import wirec as nwirec
+        from cadence_tpu.native.feeder import feed_corpus_wirec
+
+        hists = self._hists()
+        monkeypatch.delenv(nwirec.NATIVE_WIREC_ENV, raising=False)
+        crc_n, err_n, rep_n = feed_corpus_wirec(hists, chunk_workflows=16)
+        monkeypatch.setenv(nwirec.NATIVE_WIREC_ENV, "0")
+        crc_p, err_p, rep_p = feed_corpus_wirec(hists, chunk_workflows=16)
+        if nwirec.native_wirec_available():
+            assert rep_n.native_wirec
+        assert not rep_p.native_wirec
+        assert (crc_n == crc_p).all()
+        assert (err_n == err_p).all()
+        assert rep_n.events == rep_p.events
+        assert rep_n.chunks == rep_p.chunks == 3
+
+    def test_native_feed_matches_direct_replay_crc(self):
+        """Native-fed CRCs == a one-shot replay of the same corpus."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+        from cadence_tpu.native.feeder import feed_corpus_wirec
+        from cadence_tpu.ops.replay import replay_to_payload
+
+        hists = self._hists()
+        max_events = max(history_length(h) for h in hists)
+        crcs, errors, report = feed_corpus_wirec(hists, chunk_workflows=16,
+                                                 max_events=max_events)
+        assert (errors == 0).all()
+        assert report.profile_refits == 0
+        assert report.h2d_s >= 0.0
+        rows, _ = replay_to_payload(
+            jnp.asarray(encode_corpus(hists, max_events)), DEFAULT_LAYOUT)
+        assert (crcs == crc32_of_rows(np.asarray(rows))).all()
+
+    def test_feed_appends_o_new_events_and_payload_parity(self):
+        """The suffix-append feeder leg: PackCache.encode_suffix +
+        resident from-state replay — launched chunk shapes are sized by
+        the SUFFIX event axis (O(new events)), payloads equal a full
+        replay, and a second pass serves exact hits with zero device
+        events."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+        from cadence_tpu.engine.cache import PackCache, content_address
+        from cadence_tpu.engine.ladder import EscalationLadder
+        from cadence_tpu.engine.resident import ResidentStateCache
+        from cadence_tpu.native.feeder import feed_appends
+        from cadence_tpu.ops.encode import assemble_corpus
+        from cadence_tpu.ops.payload import payload_rows
+        from cadence_tpu.ops.replay import replay_events
+
+        layout = DEFAULT_LAYOUT
+        hists = generate_corpus("basic", num_workflows=16, seed=33,
+                                target_events=60)
+        keys = [("d", f"wf-{i}", "r") for i in range(len(hists))]
+        pack_cache = PackCache(max_size=64)
+        cache = ResidentStateCache(layout, ladder=EscalationLadder(layout))
+        prefix_rows = [pack_cache.encode(k, h[:-1])
+                       for k, h in zip(keys, hists)]
+        corpus = assemble_corpus(prefix_rows,
+                                 max(r.shape[0] for r in prefix_rows))
+        s = replay_events(jnp.asarray(corpus), layout)
+        rows = np.asarray(payload_rows(s, layout))
+        branch = np.asarray(s.current_branch)
+        for i, k in enumerate(keys):
+            assert cache.admit(k, content_address(hists[i][:-1]),
+                               cache.extract_row(s, i), rows[i],
+                               int(branch[i]))
+
+        items = [(k, h) for k, h in zip(keys, hists)]
+        results, report = feed_appends(items, cache, pack_cache)
+        assert all(r.ok for r in results)
+        assert report.events > 0 and report.chunks >= 1
+        # O(new events): every launched suffix axis is far below the
+        # (bucketed) history axis
+        history_e = corpus.shape[1]
+        for _w, e in cache.last_append.chunk_shapes:
+            assert e <= max(16, history_e // 2), (e, history_e)
+        # payload parity vs full replay
+        full_rows = [pack_cache.encode(k, h) for k, h in zip(keys, hists)]
+        full = assemble_corpus(full_rows,
+                               max(r.shape[0] for r in full_rows))
+        s2 = replay_events(jnp.asarray(full), layout)
+        expect = np.asarray(payload_rows(s2, layout))
+        got = np.stack([np.asarray(r.payload) for r in results])
+        assert (got == expect).all()
+        # exact-hit pass: served from resident payloads, no device work
+        results2, report2 = feed_appends(items, cache, pack_cache)
+        assert all(r.ok for r in results2)
+        assert report2.events == 0 and report2.chunks == 0
+        got2 = np.stack([np.asarray(r.payload) for r in results2])
+        assert (got2 == expect).all()
+
+    def test_heterogeneous_stream_refits_identically(self, monkeypatch):
+        """A stream whose later chunks fall outside chunk 0's pinned
+        profile must REFIT (counted, never silent) on both encoders and
+        still land on identical CRCs — the refit contract is
+        path-independent, including the native fast path that re-emits
+        from the already-decoded lanes scratch."""
+        from cadence_tpu.native import wirec as nwirec
+        from cadence_tpu.native.feeder import feed_corpus_wirec
+
+        hists = generate_corpus("basic", num_workflows=16, seed=3,
+                                target_events=30)
+        hists += generate_corpus("timer_retry", num_workflows=16, seed=3,
+                                 target_events=30)
+        monkeypatch.delenv(nwirec.NATIVE_WIREC_ENV, raising=False)
+        crc_n, err_n, rep_n = feed_corpus_wirec(hists, chunk_workflows=16)
+        monkeypatch.setenv(nwirec.NATIVE_WIREC_ENV, "0")
+        crc_p, err_p, rep_p = feed_corpus_wirec(hists, chunk_workflows=16)
+        assert rep_n.profile_refits == rep_p.profile_refits >= 1, \
+            "the heterogeneous stream no longer exercises the refit path"
+        assert (crc_n == crc_p).all()
+        assert (err_n == err_p).all()

@@ -1,5 +1,8 @@
 """Native packer parity: C++ decode+pack must be byte-identical to the
-Python packer on every suite, and the codec must round-trip."""
+Python packer on every suite, and the codec must round-trip; the native
+wirec encoder (native/wirec.cc, ISSUE 9) must be byte-identical to
+ops/wirec.pack_wirec — corpus bytes, pinned-profile streaming chunks,
+ProfileMisfit refit signal, and the PackCache suffix-repack path."""
 import numpy as np
 import pytest
 
@@ -11,6 +14,8 @@ from cadence_tpu.native.packing import encode_corpus_native, pack_serialized
 
 native = pytest.mark.skipif(native_build.load() is None,
                             reason="no C++ toolchain")
+native_wirec = pytest.mark.skipif(native_build.load_wirec() is None,
+                                  reason="no C++ toolchain")
 
 
 @native
@@ -182,3 +187,257 @@ class TestNativePacker32:
         assert (got == ev).all()
         got32 = pack_serialized32(blobs, ev.shape[1])
         assert (got32 == to_wire32(ev)).all()
+
+
+def _assert_corpus_equal(a, b, ctx=""):
+    assert a.profile == b.profile, f"{ctx}: profile drift"
+    assert a.slab.shape == b.slab.shape, ctx
+    assert (a.slab == b.slab).all(), f"{ctx}: slab bytes diverge"
+    assert (a.bases == b.bases).all(), f"{ctx}: bases diverge"
+    assert (a.n_events == b.n_events).all(), f"{ctx}: n_events diverge"
+
+
+@native_wirec
+class TestNativeWirec:
+    """Byte-parity contract of the native wirec encoder (ISSUE 9): every
+    slab byte, bases column, n_events entry, and the measured PROFILE
+    itself must equal ops/wirec.pack_wirec's — profiles are static jit
+    arguments, so profile drift would mean different executables (and a
+    broken refit contract), not just different bytes."""
+
+    @pytest.mark.parametrize("suite", SUITES)
+    @pytest.mark.parametrize("seed", [31, 77])
+    def test_byte_parity_fuzz_every_suite(self, suite, seed):
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        ev = encode_corpus(generate_corpus(suite, num_workflows=10,
+                                           seed=seed, target_events=70))
+        _assert_corpus_equal(pack_wirec(ev), pack_wirec_native(ev),
+                             f"{suite}/{seed}")
+
+    def test_measure_profile_matches_python(self):
+        """The native plan (kind/width/scale/const per lane) is the exact
+        decision procedure of _plan_lane — asserted standalone because a
+        profile mismatch poisons every pinned-profile consumer."""
+        from cadence_tpu.native.wirec import measure_profile_native
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        for suite in SUITES:
+            ev = encode_corpus(generate_corpus(suite, num_workflows=8,
+                                               seed=13, target_events=50))
+            assert measure_profile_native(ev) == pack_wirec(ev).profile
+
+    def test_threaded_emit_byte_identical(self):
+        """Multi-threaded native emit (workflow-row blocks) == serial."""
+        from cadence_tpu.native.wirec import pack_wirec_native
+
+        ev = encode_corpus(generate_corpus("timer_retry", num_workflows=96,
+                                           seed=23, target_events=30))
+        _assert_corpus_equal(pack_wirec_native(ev, num_threads=1),
+                             pack_wirec_native(ev, num_threads=4),
+                             "threaded")
+
+    def test_adversarial_lanes_byte_parity(self):
+        """Pathological lane values (wild 64-bit magnitudes, negatives,
+        zero-escape TSREL shapes) — the degradation path must stay
+        byte-identical, floor-division quotients included."""
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.encode import NUM_LANES
+        from cadence_tpu.ops.wirec import decode_wirec, pack_wirec
+
+        rng = np.random.default_rng(5)
+        W, E = 12, 24
+        ev = np.zeros((W, E, NUM_LANES), dtype=np.int64)
+        n = rng.integers(3, E, size=W)
+        for w in range(W):
+            ev[w, :n[w], 0] = np.arange(1, n[w] + 1)
+            ev[w, :n[w], 1] = rng.integers(0, 40, n[w])
+            ev[w, :n[w], 3] = rng.integers(-2**62, 2**62, n[w])
+            ev[w, :n[w], 7] = rng.integers(-2**31, 2**31, n[w])
+            # sparse huge-absolute lane: the TSREL_NZ shape
+            mask = rng.random(n[w]) < 0.5
+            ev[w, :n[w], 8] = np.where(
+                mask, 1_700_000_000_000_000_000
+                + rng.integers(0, 1 << 40, n[w]), 0)
+            ev[w, n[w]:, 1] = -1
+        py = pack_wirec(ev)
+        nat = pack_wirec_native(ev)
+        _assert_corpus_equal(py, nat, "adversarial")
+        back = np.asarray(decode_wirec(nat.slab, nat.bases, nat.n_events,
+                                       nat.profile))
+        assert (back == ev).all()
+
+    def test_pinned_profile_streaming_chunks_fused(self):
+        """The streaming shape: chunk 0 measures, later chunks emit under
+        the PIN through the fused native call (blobs → lanes → wirec in
+        one pass) into ONE reusable WirecBuffers slot — every chunk
+        byte-identical to the numpy encoder under the same pin, with no
+        stale bytes surviving slot reuse."""
+        from cadence_tpu.core.codec import serialize_corpus
+        from cadence_tpu.native.packing import pack_serialized
+        from cadence_tpu.native.wirec import (
+            WirecBuffers,
+            pack_serialized_wirec,
+        )
+        from cadence_tpu.ops.encode import history_length
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        hists = generate_corpus("basic", num_workflows=24, seed=41,
+                                target_events=60)
+        max_events = max(history_length(h) for h in hists)
+        chunk_w = 8
+        blobs = serialize_corpus(hists)
+        buf = WirecBuffers(chunk_w, max_events)
+        pinned = None
+        for lo in range(0, len(blobs), chunk_w):
+            chunk = blobs[lo:lo + chunk_w]
+            corpus, total = pack_serialized_wirec(
+                chunk, max_events, profile=pinned, out=buf)
+            dense = pack_serialized(chunk, max_events)
+            expect = pack_wirec(dense, profile=pinned)
+            _assert_corpus_equal(expect, corpus, f"chunk@{lo}")
+            assert total == int(expect.n_events.sum())
+            if pinned is None:
+                pinned = corpus.profile
+            else:
+                assert corpus.profile == pinned
+
+    def test_profile_misfit_parity_and_refit(self):
+        """A chunk outside the pinned widths must raise ProfileMisfit on
+        BOTH encoders (the refit signal is path-independent), and the
+        refit both sides then perform must land on identical bytes."""
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.encode import NUM_LANES
+        from cadence_tpu.ops.wirec import ProfileMisfit, pack_wirec
+
+        def corpus_with_ts_step(step):
+            W, E = 6, 16
+            ev = np.zeros((W, E, NUM_LANES), dtype=np.int64)
+            for w in range(W):
+                ev[w, :, 0] = np.arange(1, E + 1)
+                ev[w, :, 1] = 5
+                ev[w, :, 3] = 1_000_000 + np.arange(E) * step
+            return ev
+
+        narrow = corpus_with_ts_step(1)       # 1-byte deltas
+        wide = corpus_with_ts_step(1 << 40)   # overflow the pinned width
+        pin = pack_wirec(narrow).profile
+        assert pack_wirec_native(narrow).profile == pin
+        with pytest.raises(ProfileMisfit):
+            pack_wirec(wide, profile=pin)
+        with pytest.raises(ProfileMisfit):
+            pack_wirec_native(wide, profile=pin)
+        # the refit: fresh measurement on the misfitting chunk, both
+        # sides, identical plan and bytes
+        _assert_corpus_equal(pack_wirec(wide), pack_wirec_native(wide),
+                             "refit")
+
+    def test_scale_misfit_parity(self):
+        """Scale (GCD) misfits — values that fit the width but break the
+        pinned tick — must also raise on both sides."""
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.encode import NUM_LANES
+        from cadence_tpu.ops.wirec import ProfileMisfit, pack_wirec
+
+        def corpus(step):
+            ev = np.zeros((4, 8, NUM_LANES), dtype=np.int64)
+            for w in range(4):
+                ev[w, :, 0] = np.arange(1, 9)
+                ev[w, :, 1] = 5
+                ev[w, :, 3] = 1_000 + np.arange(8) * step
+            return ev
+
+        pin = pack_wirec(corpus(1000)).profile   # tick of 1000
+        off_tick = corpus(1001)                  # same widths, wrong tick
+        raised_py = raised_nat = False
+        try:
+            pack_wirec(off_tick, profile=pin)
+        except ProfileMisfit:
+            raised_py = True
+        try:
+            pack_wirec_native(off_tick, profile=pin)
+        except ProfileMisfit:
+            raised_nat = True
+        assert raised_py == raised_nat
+
+    def test_suffix_repack_parity_via_packcache(self):
+        """The append configuration: PackCache re-encodes only the
+        appended suffix (resumed interner), and the wirec corpus built
+        from those suffix-path lanes must be byte-identical native vs
+        Python — the suffix-append feeder leg rides exactly this."""
+        from cadence_tpu.engine.cache import PackCache
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.encode import assemble_corpus
+        from cadence_tpu.ops.wirec import pack_wirec
+        from cadence_tpu.utils import metrics as m
+
+        hists = generate_corpus("concurrent_child", num_workflows=8,
+                                seed=19, target_events=50)
+        keys = [("d", f"w{i}", "r") for i in range(len(hists))]
+        cache = PackCache(max_size=32)
+        for k, h in zip(keys, hists):
+            cache.encode(k, h[:-1])  # warm the prefix entries
+        before = m.DEFAULT_REGISTRY.counter(m.SCOPE_PACK_CACHE,
+                                            m.M_CACHE_SUFFIX_PACKS)
+        suffixes = [cache.encode_suffix(k, h, len(h) - 1)
+                    for k, h in zip(keys, hists)]
+        assert m.DEFAULT_REGISTRY.counter(
+            m.SCOPE_PACK_CACHE, m.M_CACHE_SUFFIX_PACKS) \
+            >= before + len(hists)
+        suf = assemble_corpus(suffixes,
+                              max(r.shape[0] for r in suffixes))
+        _assert_corpus_equal(pack_wirec(suf), pack_wirec_native(suf),
+                             "suffix")
+        # and the suffix-path lanes equal the tail of a cold full pack
+        full = [cache.encode(k, h) for k, h in zip(keys, hists)]
+        for i, (k, h) in enumerate(zip(keys, hists)):
+            from cadence_tpu.ops.encode import (
+                encode_batches_resumable,
+                history_length,
+            )
+            cold, _ = encode_batches_resumable(h)
+            assert (suffixes[i]
+                    == cold[history_length(h[:-1]):]).all()
+
+    def test_env_knob_pins_python_path(self, monkeypatch):
+        """CADENCE_TPU_NATIVE_WIREC=0 must route pack_wirec_auto down the
+        pure-Python encoder (counted under tpu.native/python-packs) and
+        still produce the identical corpus."""
+        from cadence_tpu.native.wirec import pack_wirec_auto
+        from cadence_tpu.utils import metrics as m
+        from cadence_tpu.utils.metrics import MetricsRegistry
+
+        ev = encode_corpus(generate_corpus("basic", num_workflows=6,
+                                           seed=3, target_events=40))
+        reg_on, reg_off = MetricsRegistry(), MetricsRegistry()
+        monkeypatch.delenv("CADENCE_TPU_NATIVE_WIREC", raising=False)
+        on = pack_wirec_auto(ev, registry=reg_on)
+        assert reg_on.counter(m.SCOPE_TPU_NATIVE, m.M_NATIVE_PACKS) == 1
+        monkeypatch.setenv("CADENCE_TPU_NATIVE_WIREC", "0")
+        off = pack_wirec_auto(ev, registry=reg_off)
+        assert reg_off.counter(m.SCOPE_TPU_NATIVE, m.M_NATIVE_PY_PACKS) == 1
+        _assert_corpus_equal(on, off, "env-knob")
+
+    def test_device_crc_parity_native_corpus(self):
+        """End to end: a natively packed corpus replays on device to the
+        same CRCs as the Python-packed one, every suite."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+        from cadence_tpu.native.wirec import pack_wirec_native
+        from cadence_tpu.ops.replay import replay_wirec_to_crc
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        for suite in SUITES:
+            ev = encode_corpus(generate_corpus(suite, num_workflows=6,
+                                               seed=29, target_events=40))
+            py, nat = pack_wirec(ev), pack_wirec_native(ev)
+            crc_p, err_p = replay_wirec_to_crc(
+                jnp.asarray(py.slab), jnp.asarray(py.bases),
+                jnp.asarray(py.n_events), py.profile, DEFAULT_LAYOUT)
+            crc_n, err_n = replay_wirec_to_crc(
+                jnp.asarray(nat.slab), jnp.asarray(nat.bases),
+                jnp.asarray(nat.n_events), nat.profile, DEFAULT_LAYOUT)
+            assert (np.asarray(crc_p) == np.asarray(crc_n)).all(), suite
+            assert (np.asarray(err_p) == np.asarray(err_n)).all(), suite

@@ -47,7 +47,16 @@ def _load_bench(env: str):
     if not path or not os.path.exists(path):
         pytest.skip(f"{env} not set (run via deploy/smoke_perf.sh)")
     with open(path) as f:
-        return json.load(f)
+        doc = json.load(f)
+    if "detail" not in doc and "parsed" in doc:
+        # driver-recorded BENCH_r0N.json wrapper: the bench JSON rides
+        # in `parsed` (None when only an output tail was captured —
+        # nothing to gate against, so skip rather than KeyError)
+        if doc["parsed"] is None:
+            pytest.skip(f"{env}: recorded baseline carries no parsed "
+                        f"bench JSON (tail-only capture)")
+        doc = doc["parsed"]
+    return doc
 
 
 class TestPipelinedParity:
@@ -275,6 +284,100 @@ class TestMeshGate:
         assert cur["per_device_efficiency"] >= 0.7, (
             f"per-device efficiency {cur['per_device_efficiency']} "
             f"below 0.7 at {cur['devices']} devices")
+
+
+class TestFeederGate:
+    """The host-ingest gate (ISSUE 9): the native-wirec feeder closed
+    the 6x pack/replay gap, so the feeder's sustained rate must stay
+    within FEEDER_GATE_RATIO (default 0.5 — i.e. within 2x) of the
+    recorded device transfer-included rate on the same corpus family,
+    the suffix-append leg must cost by APPENDED events, and a warm
+    homogeneous stream must recompile nothing (pinned profile ⇒ one
+    executable; checked against the jit cache itself)."""
+
+    def test_streaming_zero_warm_recompiles(self):
+        """Two passes of the same homogeneous stream: zero refits on
+        both, identical CRCs, and the decode/replay jit cache must not
+        grow on the second — the pinned profile is provably one
+        executable, not one per chunk."""
+        from cadence_tpu.gen.corpus import generate_corpus
+        from cadence_tpu.native import packing
+        from cadence_tpu.native.feeder import feed_corpus_wirec
+        from cadence_tpu.ops.replay import replay_wirec_to_crc
+
+        if not packing.native_available():
+            pytest.skip("no C++ toolchain")
+        hists = generate_corpus("basic", num_workflows=96, seed=41,
+                                target_events=30)
+        crc1, err1, rep1 = feed_corpus_wirec(hists, chunk_workflows=32)
+        assert rep1.profile_refits == 0, \
+            "a homogeneous stream refit its pinned profile"
+        assert (err1 == 0).all()
+        size0 = replay_wirec_to_crc._cache_size()
+        crc2, _err2, rep2 = feed_corpus_wirec(hists, chunk_workflows=32)
+        assert rep2.profile_refits == 0
+        assert replay_wirec_to_crc._cache_size() == size0, \
+            "a warm streaming pass compiled a new wirec executable"
+        assert (crc1 == crc2).all()
+
+    def test_feeder_within_2x_of_device_rate(self):
+        """Recorded gate: sustained feeder events/s vs the same bench
+        run's device transfer-included rate on the matching corpus
+        family — the 6x gap (BENCH_r05: 622k feed vs 3.9M replay) must
+        not creep back."""
+        cur = _load_bench("PERF_CURRENT")["detail"]
+        feeder = cur.get("feeder")
+        if not feeder:
+            pytest.skip("bench recorded no feeder section "
+                        "(no native toolchain on the recording box)")
+        assert feeder["error_workflows"] == 0
+        device = cur["suites"].get("basic", {}).get(
+            "transfer_included_rate")
+        assert device, "no basic-suite transfer rate to gate against"
+        ratio = float(os.environ.get("FEEDER_GATE_RATIO", "0.5"))
+        sustained = feeder["sustained_events_per_sec"]
+        assert sustained >= ratio * device, (
+            f"feeder sustained {sustained} events/s fell below "
+            f"{ratio:.0%} of the device transfer-included rate {device} "
+            f"— host packing is the bottleneck again")
+
+    def test_feeder_sustained_vs_baseline(self):
+        """Recorded regression gate: the feeder rate itself must hold
+        within PERF_TOLERANCE of the recorded baseline's (baselines
+        predating the feeder section skip)."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("feeder")
+        if not cur:
+            pytest.skip("bench recorded no feeder section "
+                        "(no native toolchain on the recording box)")
+        base = _load_bench("PERF_BASELINE").get("detail", {}).get("feeder")
+        if not base:
+            pytest.skip("baseline predates the feeder section")
+        tol = float(os.environ.get("PERF_TOLERANCE", "0.5"))
+        floor = tol * base["sustained_events_per_sec"]
+        assert cur["sustained_events_per_sec"] >= floor, (
+            f"feeder sustained {cur['sustained_events_per_sec']} "
+            f"regressed below {tol:.0%} of baseline "
+            f"{base['sustained_events_per_sec']}")
+
+    def test_suffix_append_recorded_o_new_events(self):
+        """Recorded gate: the suffix-append feeder leg resolved every
+        append and its wall time is set by APPENDED events — the
+        history-equivalent rate (what an O(history) path would have had
+        to sustain in the same wall time) dwarfs the appended rate,
+        which is exactly the residency claim."""
+        feeder = _load_bench("PERF_CURRENT")["detail"].get("feeder")
+        if not feeder:
+            pytest.skip("bench recorded no feeder section "
+                        "(no native toolchain on the recording box)")
+        sa = feeder.get("suffix_append")
+        if not sa:
+            pytest.skip("recorded feeder section predates suffix_append")
+        assert sa["ok"] == sa["workflows"], sa
+        assert sa["appended_events_per_sec"] > 0
+        assert sa["history_events_per_sec"] \
+            >= 4 * sa["appended_events_per_sec"], (
+                "suffix appends are paying near full-history cost — "
+                "the O(new events) path broke")
 
 
 class TestBaselineGate:

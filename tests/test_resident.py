@@ -142,6 +142,38 @@ class TestFromStateKernels:
         assert not np.asarray(ovf).any()
         assert (np.asarray(crc).astype(np.uint32) == crc_full).all()
 
+    def test_wirec_suffix_payload_parity(self):
+        """The payload twin of the compressed suffix path
+        (replay_wirec_from_state_to_payload — the serving shape): wirec
+        suffix from-state replay lands on the exact payload rows of the
+        dense from-state replay and of a full-history replay."""
+        import jax.numpy as jnp
+
+        from cadence_tpu.ops.replay import (
+            replay_events,
+            replay_wirec_from_state_to_payload,
+        )
+        from cadence_tpu.ops.wirec import pack_wirec
+
+        hists = generate_corpus("basic", num_workflows=6, seed=17,
+                                target_events=32)
+        _, rows_full = _replay_full(hists)
+        prefixes = [encode_batches_resumable(h[:-1]) for h in hists]
+        pref = assemble_corpus([r for r, _ in prefixes],
+                               max(r.shape[0] for r, _ in prefixes))
+        s_pref = replay_events(jnp.asarray(pref))
+        suffix_rows = [encode_batches_resumable(h[-1:], mp)[0]
+                       for h, (_, mp) in zip(hists, prefixes)]
+        suf = assemble_corpus(suffix_rows,
+                              max(r.shape[0] for r in suffix_rows))
+        wc = pack_wirec(suf)
+        _s, rows, err, ovf = replay_wirec_from_state_to_payload(
+            jnp.asarray(wc.slab), jnp.asarray(wc.bases),
+            jnp.asarray(wc.n_events), wc.profile, s_pref, DEFAULT_LAYOUT)
+        assert (np.asarray(err) == 0).all()
+        assert not np.asarray(ovf).any()
+        assert (np.asarray(rows) == rows_full).all()
+
     def test_widen_then_suffix_replay_then_narrow(self):
         """A base state widened to 2K replays the suffix to the same
         base-width payload, and narrow_state round-trips it back."""
