@@ -752,6 +752,169 @@ def _feeder_append_rate(layout, workflows: int = 0):
     }
 
 
+def _serving_suite(layout, workflows: int = 0, target_events: int = 0,
+                   levels=(1, 2, 4, 8)):
+    """The device-serving transaction tier (engine/serving.py) measured
+    at the scheduler seam: N submitter threads drive committed append
+    transactions (each waits for its device parity result — offered
+    concurrency == N), the scheduler coalesces them into shared
+    from-state launches, and the suite records coalescing factor and
+    latency percentiles per concurrency level. An UNBATCHED baseline
+    (max_batch=1, zero window — one launch per transaction) runs at the
+    top level so the micro-batching claim is a measured ratio, not a
+    design note; tests/test_perf_gate.py TestServingGate pins
+    batched p99 <= unbatched p99, factor > 1.5 at saturation, zero
+    warm recompiles, zero parity divergence."""
+    import threading
+
+    from cadence_tpu.core.checksum import STICKY_ROW_INDEX, payload_row
+    from cadence_tpu.engine.cache import batch_crc
+    from cadence_tpu.engine.persistence import Stores
+    from cadence_tpu.engine.serving import ServingScheduler
+    from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+    from cadence_tpu.gen.corpus import generate_corpus
+    from cadence_tpu.oracle.state_builder import StateBuilder
+    from cadence_tpu.ops.replay import replay_from_state_to_payload
+    from cadence_tpu.utils import metrics as cm
+
+    workflows = workflows or int(os.environ.get("BENCH_SERVING_WORKFLOWS",
+                                                "64"))
+    target_events = target_events or int(
+        os.environ.get("BENCH_SERVING_EVENTS", "96"))
+    hists = generate_corpus("basic", num_workflows=workflows,
+                            seed=20260803, target_events=target_events)
+    # every level appends TWO batches per workflow (an untimed warm
+    # round traces this level's stack/flush shapes, then the timed
+    # round); the prefix leaves enough tail for all levels plus the
+    # unbatched baseline
+    appends_needed = 2 * len(levels) + 2
+    min_batches = min(len(h) for h in hists)
+    assert min_batches > appends_needed + 1, (min_batches, appends_needed)
+    prefix = min_batches - appends_needed
+    keys = [("bench", f"sv-{i}", "r") for i in range(workflows)]
+    counts = {k: prefix for k in keys}
+    by_key = {k: h for k, h in zip(keys, hists)}
+
+    def read_batches(key):
+        return by_key[key][:counts[key]]
+
+    def expected_for(key):
+        ms = StateBuilder().replay_history(read_batches(key))
+        row = payload_row(ms, layout)
+        row[STICKY_ROW_INDEX] = 0
+        return row, int(ms.version_histories.current_index)
+
+    registry = cm.DEFAULT_REGISTRY
+
+    def make_scheduler(max_batch, max_wait_us):
+        tpu = TPUReplayEngine(Stores(), layout)
+        sched = ServingScheduler(tpu, max_batch=max_batch,
+                                 max_wait_us=max_wait_us,
+                                 read_batches=read_batches)
+        sched.warm(e_shapes=(16, 32))
+        # seed: one cold submit per workflow pins every prefix state
+        for k in keys:
+            row, br = expected_for(k)
+            sched.submit(k, row, br, batch_crc(read_batches(k)[-1]))
+        assert sched.drain(timeout=300.0)
+        return sched
+
+    def drive(sched, conc, wf_slice):
+        """conc threads, each appending one batch per owned workflow and
+        blocking on its parity ticket; returns sorted latencies."""
+        lats, errs = [], []
+        lock = threading.Lock()
+        barrier = threading.Barrier(conc)
+        shares = [wf_slice[i::conc] for i in range(conc)]
+
+        def worker(share):
+            barrier.wait()
+            for k in share:
+                counts[k] += 1
+                row, br = expected_for(k)
+                t0 = time.perf_counter()
+                ticket = sched.submit(k, row, br,
+                                      batch_crc(read_batches(k)[-1]))
+                res = ticket.result(timeout=300.0)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lats.append(dt)
+                    if not (res.ok and res.parity_ok):
+                        errs.append(res)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in shares if s]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:3]
+        lats.sort()
+        return lats
+
+    suite = {"workflows": workflows, "levels": [], "parity_divergence": 0}
+    # max_batch pinned to the top concurrency level: warm() derives its
+    # widths from it, so the suite pre-compiles exactly the flush shapes
+    # the drive can produce (a wider batch would just warm more shapes)
+    sched = make_scheduler(max_batch=max(levels), max_wait_us=4000)
+    size0 = None
+    for conc in levels:
+        drive(sched, conc, keys)  # warm round: trace this level's shapes
+        if size0 is None:
+            # everything after the first level's warm round must reuse
+            # the compiled from-state executables — zero warm recompiles
+            size0 = replay_from_state_to_payload._cache_size()
+        pre_txn = registry.counter(cm.SCOPE_TPU_SERVING, cm.M_SERVING_TXNS)
+        pre_launch = registry.counter(cm.SCOPE_TPU_SERVING,
+                                      cm.M_SERVING_LAUNCHES)
+        lats = drive(sched, conc, keys)
+        txns = registry.counter(cm.SCOPE_TPU_SERVING,
+                                cm.M_SERVING_TXNS) - pre_txn
+        launches = registry.counter(cm.SCOPE_TPU_SERVING,
+                                    cm.M_SERVING_LAUNCHES) - pre_launch
+        suite["levels"].append({
+            "concurrency": conc,
+            "txns": txns,
+            "launches": launches,
+            "coalescing_factor": round(txns / launches, 3) if launches
+            else 0.0,
+            "p50_ms": round(1e3 * lats[len(lats) // 2], 3),
+            "p99_ms": round(1e3 * lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))], 3),
+        })
+    suite["warm_recompiles"] = (replay_from_state_to_payload._cache_size()
+                                - size0)
+    sched.stop()
+
+    # unbatched baseline: one launch per transaction (max_batch=1, no
+    # window) at the top concurrency — what the tier costs WITHOUT
+    # micro-batching (warm round first, same as the batched levels)
+    top = max(levels)
+    unbatched = make_scheduler(max_batch=1, max_wait_us=0)
+    drive(unbatched, top, keys)
+    lats = drive(unbatched, top, keys)
+    unbatched.stop()
+    suite["unbatched"] = {
+        "concurrency": top,
+        "p50_ms": round(1e3 * lats[len(lats) // 2], 3),
+        "p99_ms": round(1e3 * lats[min(len(lats) - 1,
+                                       int(len(lats) * 0.99))], 3),
+    }
+    batched_top = next(lv for lv in suite["levels"]
+                       if lv["concurrency"] == top)
+    suite["batched_p99_ms"] = batched_top["p99_ms"]
+    suite["unbatched_p99_ms"] = suite["unbatched"]["p99_ms"]
+    suite["coalescing_factor_at_top"] = batched_top["coalescing_factor"]
+    suite["parity_divergence"] = registry.counter(
+        cm.SCOPE_TPU_SERVING, cm.M_SERVING_DIVERGENCE)
+    suite["note"] = (
+        "submitters block on per-transaction parity tickets, so offered "
+        "concurrency == thread count; batched levels share one "
+        "from-state launch per flush window, the unbatched baseline "
+        "pays one launch per transaction")
+    return suite
+
+
 def main() -> None:
     ns_workflows = int(os.environ.get("BENCH_NS_WORKFLOWS", "1000000"))
     ns_events = int(os.environ.get("BENCH_NS_EVENTS", "1000"))
@@ -777,6 +940,7 @@ def main() -> None:
     incremental = _incremental_suite(layout)
     mesh_serving = _mesh_serving(
         int(os.environ.get("BENCH_MESH_WORKFLOWS", "4096")), layout)
+    serving = _serving_suite(layout)
     feeder = _feeder_rate(layout)
 
     # observability snapshot: the profiler's pack/h2d/kernel/readback leg
@@ -810,6 +974,7 @@ def main() -> None:
             "fallback_under_pressure": fallback,
             "incremental": incremental,
             "mesh_serving": mesh_serving,
+            "serving": serving,
             "feeder": feeder,
             "observability": observability,
         },

@@ -183,6 +183,7 @@ def main(argv=None) -> int:
                      help="verify passes to run first (pass 1 seeds the "
                           "cache, pass 2 measures the warm hit rate; "
                           "0 = dump current stats only)")
+    adm.add_parser("serving")
 
     # WAL tools (adminDBScan/adminDBClean analogs over the one backend)
     wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
@@ -213,6 +214,19 @@ def main(argv=None) -> int:
     # LOADGEN_r0N.json trajectory next to BENCH_r*.json
     load_grp = sub.add_parser("load").add_subparsers(dest="cmd",
                                                      required=True)
+    # the serving-tier comparison (in-process, tier on vs off; records
+    # decision-transaction p50/p99, launches/sec, coalescing factor)
+    sv = load_grp.add_parser("serving")
+    sv.add_argument("--duration", type=float, default=4.0)
+    sv.add_argument("--rps", type=float, default=160.0,
+                    help="scheduled decision-transaction arrival rate")
+    sv.add_argument("--workers", type=int, default=16)
+    sv.add_argument("--pool-size", type=int, default=12)
+    sv.add_argument("--seed", type=int, default=20260803)
+    sv.add_argument("--record", action="store_true",
+                    help="write the next LOADGEN_r0N.json in CWD")
+    sv.add_argument("--out", default="",
+                    help="explicit trajectory path (implies --record)")
     for cmd_name in ("run", "overload"):
         lp = load_grp.add_parser(cmd_name)
         lp.add_argument("--duration", type=float, default=10.0)
@@ -245,6 +259,11 @@ def main(argv=None) -> int:
                                  "its quota")
             lp.add_argument("--victim-p99-slo-ms", type=float,
                             default=2500.0)
+            lp.add_argument("--store-faults", default="",
+                            help="store-fault spec injected into the "
+                                 "STORE server process only "
+                                 "(engine/faults.py), e.g. "
+                                 "'rate=0.04,seed=13'")
 
     args = parser.parse_args(argv)
     if args.group == "load":
@@ -505,6 +524,10 @@ def main(argv=None) -> int:
                                "resident_served": len(r.resident),
                                "ok": r.ok})
             _emit({"passes": passes, **admin.resident()})
+        elif args.cmd == "serving":
+            # the device-serving tier rollup (engine/serving.py):
+            # coalescing factor, queue, path mix, parity counters
+            _emit(admin.serving())
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of
@@ -539,12 +562,17 @@ def _load_tool(args) -> int:
     from .loadgen import report as lg_report
     from .loadgen import scenarios
 
-    if args.cmd == "overload":
+    if args.cmd == "serving":
+        doc = scenarios.serving_scenario(
+            duration_s=args.duration, rps=args.rps, workers=args.workers,
+            pool_size=args.pool_size, seed=args.seed)
+    elif args.cmd == "overload":
         doc = scenarios.overload_scenario(
             duration_s=args.duration, num_hosts=args.hosts,
             victim_rps=args.victim_rps,
             aggressor_quota_rps=args.aggressor_quota_rps,
             overdrive=args.overdrive, chaos_spec=args.chaos,
+            store_fault_spec=args.store_faults,
             seed=args.seed, victim_p99_slo_ms=args.victim_p99_slo_ms,
             workers=args.workers, verify=not args.no_verify)
     else:

@@ -193,3 +193,22 @@ class AdminHandler:
             "ladder_max_rungs": (cache.ladder.max_rungs
                                  if cache.ladder is not None else 0),
         }
+
+    def serving(self) -> Dict[str, Any]:
+        """Device-serving tier introspection (`admin serving` CLI verb):
+        the micro-batching transaction scheduler's knobs, queue depth,
+        coalescing factor, path mix (exact/suffix/cold), backpressure
+        and parity counters (engine/serving.py) — plus the resident
+        occupancy the tier is maintaining. Reports the wired scheduler
+        when the cluster enabled the tier; otherwise a tier-off rollup
+        over the engine's (idle) scheduler-to-be."""
+        self._authorize("serving")
+        scheduler = getattr(self.box, "serving", None)
+        if scheduler is None:
+            scheduler = self.box.tpu.serving_scheduler()
+        return {
+            "tier_wired": getattr(self.box, "serving", None) is not None,
+            **scheduler.stats(),
+            "resident_entries": len(self.box.tpu.resident),
+            "resident_bytes": self.box.tpu.resident.resident_bytes,
+        }

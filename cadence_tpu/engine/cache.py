@@ -235,6 +235,33 @@ class PackCache:
         self._put(key, rows, content_address(batches), interner_map)
         return rows
 
+    def encode_append(self, key: Tuple[str, str, str], prefix_address,
+                      new_batches, new_address) -> Optional[np.ndarray]:
+        """Suffix rows for `new_batches` appended DIRECTLY after a cached
+        prefix — the serving tier's zero-read hot path: the committed
+        batches were handed over by the engine, so when the cached entry
+        still matches `prefix_address` the suffix encodes from the
+        resumed interner without ever re-reading (or re-serializing) the
+        store history. Returns None when the entry is missing or covers
+        different bytes (caller falls back to the full-read path); on
+        success the cache is re-addressed at `new_address` so the next
+        chained append extends it again."""
+        from ..ops.encode import encode_batches_resumable
+
+        entry = self.lru.get(key)
+        if entry is None:
+            return None
+        rows, address, interner_map = entry
+        if address != prefix_address:
+            return None
+        suffix, new_map = encode_batches_resumable(new_batches,
+                                                   interner_map)
+        self.metrics.inc(self._m.SCOPE_PACK_CACHE,
+                         self._m.M_CACHE_SUFFIX_PACKS)
+        self._put(key, np.concatenate([rows, suffix]), new_address,
+                  new_map)
+        return suffix
+
     def encode_suffix(self, key: Tuple[str, str, str], batches,
                       from_batch: int) -> np.ndarray:
         """Only the rows of batches[from_batch:] — the resident-state

@@ -39,11 +39,14 @@ from ..core.enums import (
     WorkflowState,
 )
 from ..core.events import HistoryBatch, HistoryEvent, RetryPolicy
+from ..oracle import task_generator as taskgen
 from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
+from ..oracle.retry import retry_activity
 from ..oracle.state_builder import StateBuilder
 from ..utils import metrics as m
 from ..utils import tracing
 from ..utils.clock import TimeSource
+from ..utils.quotas import ServiceBusyError
 from .persistence import DomainInfo, EntityNotExistsError, Stores
 from .shard import ShardContext
 
@@ -114,6 +117,16 @@ class HistoryEngine:
         #: cluster replaces this with its shared instance
         from .notifier import HistoryNotifier
         self.notifier = HistoryNotifier()
+        #: device-serving transaction tier (engine/serving.py): when the
+        #: owning cluster wires a ServingScheduler here, every COMMITTED
+        #: transaction's batch is handed off for micro-batched from-state
+        #: replay — the oracle stays the sole authority on legality, the
+        #: device twin stays hot for the serving reads. None = tier off
+        #: (the default; CADENCE_TPU_SERVING=1 wires it at cluster boot)
+        self.serving = None
+        #: the most recent handoff's ticket (tests and sync callers block
+        #: on it; the handoff itself is fire-and-forget)
+        self.last_serving_ticket = None
 
     def _replication_target(self, domain_id: str, ms: MutableState):
         """Shared gate for both replication publish paths: (publisher,
@@ -191,6 +204,40 @@ class HistoryEngine:
 
     def _new_transaction(self, ms: MutableState) -> "_Txn":
         return _Txn(self, ms)
+
+    def _hand_to_serving(self, ms: MutableState, events_blob: bytes,
+                         batch: Optional[HistoryBatch] = None) -> None:
+        """Hand one COMMITTED transaction to the device-serving tier
+        (engine/serving.py): the oracle's post-commit payload row, the
+        committed batch's CRC32 (the content-address tail the drain uses
+        to prove the store still ends at this transaction), and the
+        committed batch ITSELF — with it a chained append flushes with
+        zero store reads. Fire and forget — queue-full backpressure is
+        counted and skipped, never a transaction failure: the oracle
+        state is already durable, only the device twin lags (it catches
+        up on the next transaction's suffix lookup)."""
+        import zlib
+
+        from ..core.checksum import STICKY_ROW_INDEX, payload_row
+
+        serving = self.serving
+        if serving is None:
+            return
+        info = ms.execution_info
+        key = (info.domain_id, info.workflow_id, info.run_id)
+        try:
+            row = payload_row(ms, serving.layout)
+            # sticky state is active-side only; replay clears it
+            row[STICKY_ROW_INDEX] = 0
+            self.last_serving_ticket = serving.submit(
+                key, row, int(ms.version_histories.current_index),
+                zlib.crc32(events_blob), batch=batch)
+        except ServiceBusyError:
+            self.last_serving_ticket = None
+        except Exception:
+            self.last_serving_ticket = None
+            self.log.warning("serving handoff failed",
+                             workflow_id=info.workflow_id)
 
     # ------------------------------------------------------------------
     # Buffered events (mutable_state_builder.go:112-114 bufferedEvents;
@@ -408,6 +455,9 @@ class HistoryEngine:
         self._publish_replication(domain_id, workflow_id, run_id, events, ms)
         self.notifier.notify((domain_id, workflow_id, run_id),
                              ms.execution_info.next_event_id, False)
+        # the start batch seeds the device twin like any other committed
+        # transaction (cold admit on the serving tier's next drain)
+        self._hand_to_serving(ms, start_blob, batch)
         return run_id
 
     # ------------------------------------------------------------------
@@ -886,7 +936,6 @@ class HistoryEngine:
         """One activity response transaction. With `try_retry`, a failure
         with remaining retry budget re-attempts transiently (no events);
         only the final outcome reaches history."""
-        from ..oracle.retry import retry_activity
         ms, expected = self._load(token.domain_id, token.workflow_id, token.run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             raise InvalidRequestError("workflow execution already completed")
@@ -946,7 +995,6 @@ class HistoryEngine:
         Replication: a sync-activity message (reference
         mutable_state_builder.go:3864 syncActivityTasks) streams the
         attempt/failure state to standbys; see _publish_sync_activity."""
-        from ..oracle import task_generator as taskgen
         taskgen.generate_activity_timer_tasks(ms)
         taskgen.generate_user_timer_tasks(ms)
         info = ms.execution_info
@@ -1206,7 +1254,6 @@ class HistoryEngine:
     def activity_timeout(self, domain_id: str, workflow_id: str, run_id: str,
                          schedule_id: int, timeout_type: int,
                          attempt: int = 0) -> None:
-        from ..oracle.retry import retry_activity
         ms, expected = self._load(domain_id, workflow_id, run_id)
         if ms.execution_info.state == WorkflowState.Completed:
             return
@@ -1669,6 +1716,10 @@ class _Txn:
         self.engine.notifier.notify(
             (info.domain_id, info.workflow_id, info.run_id),
             info.next_event_id, info.state == _WS.Completed)
+        # COMMITTED batch → device-serving tier (the tentpole seam): the
+        # oracle applied and persisted above; the scheduler maintains the
+        # HBM-resident twin and gates per-transaction parity
+        self.engine._hand_to_serving(self.ms, events_blob, batch)
         for fn in self._post:
             fn()
         self.engine._enforce_history_limits(self.ms)

@@ -101,6 +101,24 @@ class Onebox:
         self.scavenger = RetentionScavenger(self.stores, self.route,
                                             self.clock, self.metrics)
         self.scanner = ExecutionScanner(self.stores, self.tpu, self.metrics)
+        # device-serving transaction tier (engine/serving.py): wired into
+        # every engine this box creates when CADENCE_TPU_SERVING=1 —
+        # committed transactions micro-batch into from-state launches on
+        # the SAME resident pool verify_all serves from
+        from . import serving as serving_mod
+        self.serving = (self.tpu.serving_scheduler()
+                        if serving_mod.enabled() else None)
+
+    def enable_serving(self):
+        """Wire the serving tier programmatically (tests / the loadgen
+        comparison scenario flip it without env plumbing); idempotent.
+        Covers engines already created and all future ones."""
+        if self.serving is None:
+            self.serving = self.tpu.serving_scheduler()
+        for controller in self.controllers.values():
+            for engine in controller._engines.values():
+                engine.serving = self.serving
+        return self.serving
 
     def _make_engine(self, shard) -> HistoryEngine:
         engine = HistoryEngine(shard, self.stores, self.clock)
@@ -110,6 +128,9 @@ class Onebox:
         engine.metrics = self.metrics
         engine.config = self.config
         engine.notifier = self.notifier
+        # None until __init__ finishes (engines are created lazily, but
+        # a custom engine_factory caller could race construction)
+        engine.serving = getattr(self, "serving", None)
         return engine
 
     def set_replication_publisher(self, publisher) -> None:

@@ -342,6 +342,19 @@ class ResidentStateCache:
         scope.inc(m.M_CACHE_MISSES)
         return None
 
+    def entry_for(self, key: tuple) -> Optional[ResidentEntry]:
+        """The key's current entry, recency-refreshed, with NO address
+        validation and NO hit/miss accounting — the serving tier's
+        chain probe (engine/serving.py): it validates against its own
+        committed-batch CRC chain instead of re-reading the store
+        history, and falls back to lookup() when the chain breaks."""
+        with self._lock:
+            sl = self._slices[self.shard_of(key)]
+            entry = sl.get(key)
+            if entry is not None:
+                sl.move_to_end(key)
+            return entry
+
     def invalidate(self, key: tuple) -> bool:
         """Drop an entry (counted); the tail-overwrite / reset / NDC
         branch-switch seam — callers that detect a non-append mutation
@@ -415,18 +428,23 @@ class ResidentStateCache:
 
     @staticmethod
     def _stack_rows(rows: Sequence[object]):
-        """Batch W=1 state rows back into one [k, ...] ReplayState."""
-        import jax
-        import jax.numpy as jnp
+        """Batch W=1 state rows back into one [k, ...] ReplayState.
 
-        return jax.tree_util.tree_map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *rows)
+        One JITTED concatenate over the whole pytree (a list of states
+        IS a pytree argument): the serving tier stacks per flush, and
+        the eager per-leaf version paid ~66 dispatch round-trips
+        (promote_dtypes + a fresh tiny concat trace per batch-size
+        combo) — 30ms of host overhead per launch that quantized every
+        coalesced transaction's latency. Jitting collapses it to one
+        cached call per row-count."""
+        return _stack_states(list(rows))
 
     # -- the append transaction ---------------------------------------------
 
     def replay_append(self, items: Sequence[Tuple[tuple, ResidentEntry,
                                                   Sequence]],
-                      encode_suffix: Optional[Callable] = None
+                      encode_suffix: Optional[Callable] = None,
+                      address_of: Callable = content_address
                       ) -> List[AppendResult]:
         """Replay ONLY the appended batches of each item against its
         resident state; items are (key, entry, full current batches)
@@ -444,13 +462,21 @@ class ResidentStateCache:
         from the PRE-append state and the row stays resident widened
         (re-narrowing to base once narrow_ok holds); any other failure
         invalidates the entry and returns ok=False for oracle
-        arbitration."""
-        return self.replay_append_report(items, encode_suffix)[0]
+        arbitration.
+
+        `address_of` maps each item's third element to the post-append
+        ContentAddress (default: content_address over real batch lists).
+        The serving tier passes opaque (suffix rows, address) tokens
+        instead — its encode_suffix/address_of unwrap them — so chained
+        appends never materialize the full history on the host."""
+        return self.replay_append_report(items, encode_suffix,
+                                         address_of)[0]
 
     def replay_append_report(self, items: Sequence[Tuple[tuple,
                                                          ResidentEntry,
                                                          Sequence]],
-                             encode_suffix: Optional[Callable] = None
+                             encode_suffix: Optional[Callable] = None,
+                             address_of: Callable = content_address
                              ) -> Tuple[List[AppendResult], AppendReport]:
         """`replay_append` plus THIS call's AppendReport. The report is a
         per-call object (also published as `last_append` for the
@@ -471,13 +497,14 @@ class ResidentStateCache:
                                 []).append(i)
         for (rung, shard), idxs in sorted(by_group.items()):
             self._append_group(items, idxs, rung, encode_suffix, results,
-                               report, shard=shard)
+                               report, shard=shard, address_of=address_of)
         return ([r if r is not None else AppendResult(ok=False)
                  for r in results], report)
 
     def _append_group(self, items, idxs: List[int], rung: int,
                       encode_suffix, results: List, report: AppendReport,
-                      shard: int = 0) -> None:
+                      shard: int = 0,
+                      address_of: Callable = content_address) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -568,12 +595,13 @@ class ResidentStateCache:
                     results[i] = AppendResult(ok=False, error=int(err[j]))
                     continue
                 results[i] = self._readmit(
-                    key, batches, s_fin, j, rows[j], int(branch[j]), rung,
+                    key, address_of(batches), s_fin, j, rows[j],
+                    int(branch[j]), rung,
                     bool(narrow_mask[j]) if narrow_mask is not None else False)
             if flagged:
                 self._escalate(items, [group[j] for j in flagged],
                                corpus[[j for j in flagged]], rung, results,
-                               report)
+                               report, address_of=address_of)
 
     def _narrow_mask(self, s_fin, rung: int):
         """[W] bool of rows that can re-narrow to base, None at base."""
@@ -582,8 +610,9 @@ class ResidentStateCache:
         from ..ops.state import narrow_ok
         return np.asarray(narrow_ok(s_fin, self.layout))
 
-    def _readmit(self, key, batches, s_fin, row: int, payload, branch: int,
-                 rung: int, narrowable: bool) -> AppendResult:
+    def _readmit(self, key, address: ContentAddress, s_fin, row: int,
+                 payload, branch: int, rung: int,
+                 narrowable: bool) -> AppendResult:
         """Re-pin one successfully appended row (re-narrowed when its
         load drained back under base capacities)."""
         state_row = self.extract_row(s_fin, row)
@@ -592,13 +621,13 @@ class ResidentStateCache:
             state_row = narrow_state(state_row, self.layout)
             rung = 0
             self._scope().inc(m.M_RESIDENT_NARROWED)
-        self.admit(key, content_address(batches), state_row, payload,
-                   branch, rung)
+        self.admit(key, address, state_row, payload, branch, rung)
         return AppendResult(ok=True, payload=np.asarray(payload),
                             branch=branch, rung=rung)
 
     def _escalate(self, items, flat_idxs: List[int], sub: np.ndarray,
-                  rung: int, results: List, report: AppendReport) -> None:
+                  rung: int, results: List, report: AppendReport,
+                  address_of: Callable = content_address) -> None:
         """Widened re-replay of capacity-flagged appends from their
         PRE-append resident states (the entries still hold them — they
         only re-admit on success)."""
@@ -638,7 +667,7 @@ class ResidentStateCache:
                 masks[mkey] = self._narrow_mask(s_fin, got_rung)
             narrow_mask = masks[mkey]
             res = self._readmit(
-                key, batches, s_fin, local, outcome.rows[k],
+                key, address_of(batches), s_fin, local, outcome.rows[k],
                 int(outcome.branch[k]), got_rung,
                 bool(narrow_mask[local]) if narrow_mask is not None
                 else False)
@@ -655,6 +684,25 @@ def _encode_suffix_cold(key, batches, from_batch: int) -> np.ndarray:
 
     rows, _ = encode_batches_resumable(batches)
     return rows[history_length(batches[:from_batch]):]
+
+
+_STACK_FN = None
+
+
+def _stack_states(states):
+    """Jitted whole-pytree stack of W=1 state rows (one trace per row
+    count + leaf shapes, then a single cached dispatch per call)."""
+    global _STACK_FN
+    if _STACK_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def stack(ss):
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *ss)
+
+        _STACK_FN = jax.jit(stack)
+    return _STACK_FN(states)
 
 
 _SLICE_FN = None

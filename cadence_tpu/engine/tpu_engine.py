@@ -153,6 +153,21 @@ class TPUReplayEngine:
         #: the bounded-footprint contract (a long-tail history inflates
         #: only its own chunk's E)
         self.last_run_chunk_shapes: List[Tuple[int, int]] = []
+        #: lazy device-serving scheduler (engine/serving.py); created on
+        #: first request so engines that never serve pay nothing
+        self._serving = None
+
+    def serving_scheduler(self):
+        """The micro-batching transaction scheduler bound to THIS
+        engine's resident cache / pack cache / ladder / mesh — the
+        device-serving tier clusters wire into their history engines
+        (engine/serving.ServingScheduler). One per engine: the scheduler
+        and verify_all must share the resident pool, or a transaction's
+        append and a verify's admit could race different caches."""
+        if self._serving is None:
+            from .serving import ServingScheduler
+            self._serving = ServingScheduler(self)
+        return self._serving
 
     @property
     def mesh(self):
@@ -190,6 +205,8 @@ class TPUReplayEngine:
         self.ladder.metrics = registry
         if hasattr(self, "resident"):
             self.resident.metrics = registry
+        if getattr(self, "_serving", None) is not None:
+            self._serving.metrics = registry
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [

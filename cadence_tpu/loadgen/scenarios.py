@@ -42,6 +42,13 @@ AGGRESSOR_DOMAIN = "lg-aggressor"
 #: explicit spec (mirrors tests/test_chaos_soak.py rates)
 DEFAULT_CHAOS_SPEC = "drop=0.04,sever=0.02,delay=0.1,delay_ms=8,seed=17"
 
+#: seeded store-fault spec for overload-with-store-chaos runs: writes in
+#: the store-server process raise TransientStoreError BEFORE they apply
+#: (engine/faults.FaultInjector), so the retry tier heals them without
+#: double-applying — the same nothing-was-applied contract the wire
+#: chaos keeps (tests/test_chaos_soak.py rates)
+DEFAULT_STORE_FAULT_SPEC = "rate=0.04,seed=13"
+
 
 def _collect_quota_metrics(cluster) -> Dict[str, object]:
     """Per-host quotas/* counters over the admin wire op + one raw
@@ -166,6 +173,7 @@ def overload_scenario(duration_s: float = 8.0, num_hosts: int = 2,
                       aggressor_quota_rps: float = 4.0,
                       overdrive: float = 2.0,
                       chaos_spec: str = "",
+                      store_fault_spec: str = "",
                       seed: int = 20260803,
                       victim_p99_slo_ms: float = 2500.0,
                       workers: int = 32,
@@ -194,6 +202,13 @@ def overload_scenario(duration_s: float = 8.0, num_hosts: int = 2,
             "admit a request — raise the quota or lower num_hosts")
     env_per_role = {"host": {
         "CADENCE_TPU_QUOTAS": f"domain.{AGGRESSOR_DOMAIN}={per_host_quota}"}}
+    if store_fault_spec:
+        # store chaos rides the per-role seam like the per-host quotas:
+        # only the STORE server process injects (engine/faults pre-apply
+        # TransientStoreError), so the shed/SLO gate is proven to hold
+        # with the persistence tier flapping under overload too
+        env_per_role["store"] = {
+            "CADENCE_TPU_STORE_FAULTS": store_fault_spec}
 
     plans = [
         DomainPlan(VICTIM_DOMAIN, victim_rps, mix=STANDARD_MIX,
@@ -235,6 +250,7 @@ def overload_scenario(duration_s: float = 8.0, num_hosts: int = 2,
             "aggressor_quota_rps": aggressor_quota_rps,
             "aggressor_quota_rps_per_host": per_host_quota,
             "overdrive": overdrive, "chaos": chaos_spec,
+            "store_faults": store_fault_spec,
             "workers": workers,
         },
         "traffic": load.as_dict(),
@@ -261,6 +277,144 @@ def overload_scenario(duration_s: float = 8.0, num_hosts: int = 2,
         and shed_ratio >= 0.9
         and quota_metrics["shed_total_run"] > 0
         and (verify_doc is None or verify_doc["divergent"] == 0))
+    return doc
+
+
+def serving_scenario(duration_s: float = 4.0, rps: float = 160.0,
+                     workers: int = 16, pool_size: int = 12,
+                     seed: int = 20260803, num_shards: int = 4,
+                     serving_batch: int = 8,
+                     serving_wait_us: int = 80000) -> dict:
+    """The device-serving tier comparison (ISSUE 10's acceptance run):
+    the SAME seeded open-loop schedule of decision transactions (signals
+    against a long-lived pool — each one is a full history-engine
+    transaction: load → apply → persist) driven twice against a fresh
+    in-process cluster, tier OFF then tier ON, recording per-mode
+    decision-transaction p50/p99, and for the ON mode the scheduler's
+    launches/sec, coalescing factor and parity counters.
+
+    The tier's contract, gated in `doc["ok"]`:
+    - coalescing: concurrent committed transactions fold into shared
+      device launches (factor > 1.5 — one launch serves several
+      transactions' appends, the micro-batching claim);
+    - latency: the handoff is post-commit and fire-and-forget, so the
+      decision-transaction p99 with the tier ON must be no worse than
+      with it OFF (the device twin costs the request path nothing);
+    - parity: every served transaction's device payload checksum equals
+      the oracle's committed row — divergence counter 0, and the
+      post-run full verify stays green with the resident pool the tier
+      maintained.
+
+    Runs in-process (Onebox) on purpose: the comparison isolates the
+    engine transaction loop from wire/chaos noise; the wire-cluster
+    tier rides the same CADENCE_TPU_SERVING knob in production."""
+    from ..engine.onebox import Onebox
+    from ..utils import compile_cache
+    from ..utils import metrics as m
+    from .mixes import OP_SIGNAL, TrafficMix, trace_digest
+
+    compile_cache.enable()
+    domain = "lg-serving"
+    mix = TrafficMix("serving-signal", {OP_SIGNAL: 1.0})
+    plans = [DomainPlan(domain, rps, mix=mix, pool_size=pool_size)]
+    schedule = build_schedule(plans, duration_s, seed)
+
+    modes: Dict[str, dict] = {}
+    for mode in ("off", "on"):
+        box = Onebox(num_hosts=1, num_shards=num_shards)
+        if mode == "on":
+            scheduler = box.enable_serving()
+            # fixed flush width (pow2 bucket of 8) and every suffix
+            # event-bucket pre-compiled, so the measured window never
+            # pays a mid-run XLA compile (a mid-window compile stalls
+            # the drain, folds deepen, and the NEXT bucket compiles too
+            # — the snowball scheduler.warm exists to prevent); window
+            # wide enough that concurrent transactions genuinely
+            # coalesce
+            scheduler.max_batch = serving_batch
+            scheduler.max_wait_us = serving_wait_us
+            scheduler.warm()
+        gen = LoadGenerator([box.frontend], schedule, plans,
+                            workers=workers, pump=box.pump_once)
+        gen.prepare(setup_deadline_s=120.0)
+        # warmup (both modes, identical populations): two signal rounds
+        # per pool workflow compile the from-state suffix shapes BEFORE
+        # the measured window — XLA compiles are deployment warmup, not
+        # steady-state decision latency (same discipline as the reset
+        # warmup in LoadGenerator._warm_reset_path)
+        from .mixes import pool_workflow_ids
+        for rnd in range(2):
+            for wf in pool_workflow_ids(plans[0]):
+                box.frontend.signal_workflow_execution(
+                    domain, wf, "lg-warmup",
+                    request_id=f"lg-warm-{rnd}-{wf}")
+            if mode == "on":
+                box.serving.drain(timeout=120.0)
+        pre_txns = box.metrics.counter(m.SCOPE_TPU_SERVING,
+                                       m.M_SERVING_TXNS)
+        pre_launches = box.metrics.counter(m.SCOPE_TPU_SERVING,
+                                           m.M_SERVING_LAUNCHES)
+        load = gen.run()
+        if mode == "on":
+            # settle: the tier is async by design — drain the coalescing
+            # queue (and any in-flight flush) before reading counters
+            box.serving.drain(timeout=60.0)
+        pct = load.percentiles(OP_SIGNAL)
+        t = load.totals(domain)
+        doc_mode = {
+            "sent": t.sent, "ok": t.ok, "errors": t.errors,
+            "duration_s": round(load.duration_s, 3),
+            "decision_p50_ms": round(pct["p50"] * 1000, 3),
+            "decision_p99_ms": round(pct["p99"] * 1000, 3),
+        }
+        if mode == "on":
+            txns = box.metrics.counter(m.SCOPE_TPU_SERVING,
+                                       m.M_SERVING_TXNS) - pre_txns
+            launches = box.metrics.counter(
+                m.SCOPE_TPU_SERVING, m.M_SERVING_LAUNCHES) - pre_launches
+            stats = box.serving.stats()
+            doc_mode.update({
+                "serving": stats,
+                "window_transactions": txns,
+                "window_launches": launches,
+                "launches_per_sec": round(launches / load.duration_s, 2),
+                "coalescing_factor": round(txns / launches, 3)
+                if launches else 0.0,
+            })
+        verify = box.tpu.verify_all()
+        doc_mode["verify"] = {"total": verify.total,
+                              "divergent": len(verify.divergent),
+                              "resident_served": len(verify.resident),
+                              "ok": bool(verify.ok)}
+        if mode == "on":
+            box.serving.stop()
+        modes[mode] = doc_mode
+
+    on, off = modes["on"], modes["off"]
+    doc = {
+        "scenario": "serving",
+        "run": {"duration_s": duration_s, "rps": rps, "workers": workers,
+                "pool_size": pool_size, "seed": seed,
+                "num_shards": num_shards, "serving_batch": serving_batch,
+                "serving_wait_us": serving_wait_us,
+                "trace_digest": trace_digest(schedule)},
+        "off": off,
+        "on": on,
+        "comparison": {
+            "coalescing_factor": on.get("coalescing_factor", 0.0),
+            "p99_on_ms": on["decision_p99_ms"],
+            "p99_off_ms": off["decision_p99_ms"],
+            "p99_on_le_off": bool(on["decision_p99_ms"]
+                                  <= off["decision_p99_ms"]),
+            "parity_divergence": on["serving"]["parity_divergence"],
+        },
+    }
+    doc["ok"] = bool(
+        on.get("coalescing_factor", 0.0) > 1.5
+        and doc["comparison"]["p99_on_le_off"]
+        and on["serving"]["parity_divergence"] == 0
+        and on["verify"]["divergent"] == 0
+        and off["verify"]["divergent"] == 0)
     return doc
 
 

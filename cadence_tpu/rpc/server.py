@@ -267,6 +267,29 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         # (per-domain series appear as domains take traffic)
         self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_ADMITTED, 0)
         self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_SHED, 0)
+        # device-serving tier series pre-registered (tpu.serving/*): the
+        # parity-divergence counter in particular must ALWAYS scrape — a
+        # missing series and "zero divergences" must be distinguishable
+        for metric in (cm.M_SERVING_TXNS, cm.M_SERVING_LAUNCHES,
+                       cm.M_SERVING_COALESCED, cm.M_SERVING_DIVERGENCE,
+                       cm.M_SERVING_EXACT, cm.M_SERVING_SUFFIX,
+                       cm.M_SERVING_COLD, cm.M_SERVING_BYPASSED,
+                       cm.M_SERVING_REQUEUED, cm.M_SERVING_REJECTED):
+            self.metrics.inc(cm.SCOPE_TPU_SERVING, metric, 0)
+        self.metrics.gauge(cm.SCOPE_TPU_SERVING, cm.M_SERVING_QUEUE_DEPTH,
+                           0.0)
+        # the tier itself (engine/serving.py): CADENCE_TPU_SERVING=1
+        # builds this host's TPUReplayEngine over the REMOTE stores and
+        # hands every engine a shared scheduler — committed transactions
+        # micro-batch into from-state launches; default off (the tier is
+        # a deployment choice, and verify/rebuild work without it)
+        from ..engine import serving as serving_mod
+        self.serving = None
+        if serving_mod.enabled():
+            from ..engine.tpu_engine import TPUReplayEngine
+            tpu = TPUReplayEngine(self.stores, self.config.payload_layout())
+            tpu.metrics = self.metrics
+            self.serving = tpu.serving_scheduler()
         # wire chaos can also arrive via dynamicconfig (the env var is the
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)
@@ -329,6 +352,7 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         engine.metrics = self.metrics
         engine.config = self.config
         engine.replication_publisher_holder = self._publisher_holder
+        engine.serving = self.serving
         return engine
 
     # -- cluster group (XDC over the wire) ---------------------------------
@@ -524,6 +548,11 @@ class ServiceHost(socketserver.ThreadingTCPServer):
 
     def stop(self) -> None:
         self._stop.set()
+        if self.serving is not None:
+            try:
+                self.serving.stop()
+            except Exception:
+                pass
         try:
             self.scrape.stop()
         except Exception:

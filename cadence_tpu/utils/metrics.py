@@ -52,6 +52,11 @@ SCOPE_TPU_EXECUTOR = "tpu.executor"
 #: Python path (0); native-packs / python-packs count which encoder
 #: actually served each wirec pack, so a scrape settles "which path ran"
 SCOPE_TPU_NATIVE = "tpu.native"
+#: the micro-batching device-serving transaction tier (engine/serving.py
+#: ServingScheduler): committed decision transactions coalesce into one
+#: from-state launch per owning mesh device; counters below under
+#: M_SERVING_*
+SCOPE_TPU_SERVING = "tpu.serving"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -109,6 +114,11 @@ M_PROFILE_PACK_WAIT = "pack-queue-wait"
 #: capacity overflow, so this leg growing while oracle fallbacks stay
 #: flat is the ladder working as intended
 M_PROFILE_FALLBACK = "fallback"
+#: device-serving leg (engine/serving.py): the micro-batched flush of
+#: committed transactions — suffix from-state launches plus cold admits
+#: — per drain cycle; this leg next to pack/kernel says how much of a
+#: launch window the serving tier occupies
+M_PROFILE_SERVING = "serving"
 M_H2D_BYTES = "h2d-bytes"
 #: pack-cache counters (engine/cache.py PackCache, SCOPE_PACK_CACHE)
 M_CACHE_HITS = "hits"
@@ -158,6 +168,28 @@ M_QUOTA_SHED = "shed"
 M_NATIVE_AVAILABLE = "available"
 M_NATIVE_PACKS = "native-packs"
 M_NATIVE_PY_PACKS = "python-packs"
+#: device-serving transaction tier (engine/serving.py ServingScheduler,
+#: SCOPE_TPU_SERVING): committed history-engine transactions enqueue
+#: into a per-shard coalescing queue and flush as ONE from-state launch
+#: per owning mesh device — `transactions`/`batched-launches` give the
+#: coalescing factor, `coalesced-appends` counts same-workflow
+#: transactions folded into one pending append, `batch-size` and
+#: `queue-wait` are the micro-batching histograms, and
+#: `parity-divergence` counts device payloads that disagreed with the
+#: oracle's committed state (the entry is invalidated, never served)
+M_SERVING_TXNS = "transactions"
+M_SERVING_LAUNCHES = "batched-launches"
+M_SERVING_COALESCED = "coalesced-appends"
+M_SERVING_BATCH_SIZE = "batch-size"
+M_SERVING_QUEUE_WAIT = "queue-wait"
+M_SERVING_DIVERGENCE = "parity-divergence"
+M_SERVING_EXACT = "exact-serves"
+M_SERVING_SUFFIX = "suffix-appends"
+M_SERVING_COLD = "cold-admits"
+M_SERVING_BYPASSED = "bypassed"
+M_SERVING_REQUEUED = "requeued"
+M_SERVING_REJECTED = "busy-rejections"
+M_SERVING_QUEUE_DEPTH = "queue-depth"
 
 
 def ladder_rung_rows(rung: int) -> str:

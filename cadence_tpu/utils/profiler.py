@@ -16,6 +16,9 @@ ops/replay.replay_corpus) wraps its phases in a ReplayProfiler:
   fallback        — capacity-escalation ladder (engine/ladder.py): gather
                     + widened-K re-replay of overflow-flagged rows; the
                     batched replacement for the per-workflow oracle leg
+  serving         — micro-batched transaction flush (engine/serving.py):
+                    one drain cycle of the device-serving tier — suffix
+                    from-state launches plus cold full-replay admits
 
 Legs land as histograms under the component's scope (SCOPE_TPU_REPLAY by
 default, SCOPE_REBUILD for the rebuilder), so `/metrics` scrapes, the
@@ -31,7 +34,8 @@ from . import metrics as m
 
 #: the leg metric names, in pipeline order
 LEGS = (m.M_PROFILE_PACK, m.M_PROFILE_PACK_WAIT, m.M_PROFILE_H2D,
-        m.M_PROFILE_KERNEL, m.M_PROFILE_READBACK, m.M_PROFILE_FALLBACK)
+        m.M_PROFILE_KERNEL, m.M_PROFILE_READBACK, m.M_PROFILE_FALLBACK,
+        m.M_PROFILE_SERVING)
 
 
 class ReplayProfiler:

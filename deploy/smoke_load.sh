@@ -2,7 +2,9 @@
 # Load smoke: run the two-domain overload scenario against a REAL 2-host
 # wire cluster for 30s — one domain (the aggressor) driven at 2x its
 # per-domain quota, the other (the victim) running the standard mixed
-# open-loop traffic, seeded wire chaos in every process — and FAIL unless
+# open-loop traffic, seeded wire chaos in every process AND seeded
+# store faults in the store-server process (CADENCE_TPU_STORE_FAULTS
+# via the env_per_role seam) — and FAIL unless
 #   (a) the victim domain's p99 (clocked from intended send time) holds
 #       its SLO,
 #   (b) the shed counters are NONZERO on the hosts' /metrics and >= 90%

@@ -28,6 +28,13 @@
 #     uses; CADENCE_TPU_MESH_DEVICES (default 8 here, default 1 in
 #     production serving — set it to shard the serving hot path across
 #     N devices) sizes it.
+#   - the SERVING gate holds (TestServingGate, ISSUE 10): at
+#     concurrency >= 8 the device-serving transaction tier coalesces
+#     multiple committed transactions per from-state launch (factor
+#     > 1.5 at saturation), micro-batched p99 stays at or below the
+#     one-launch-per-transaction baseline, warm flushes recompile
+#     nothing, and per-transaction oracle<->device parity holds with a
+#     zero divergence counter (detail.serving in the recorded JSON);
 #   - the FEEDER gate holds (TestFeederGate, ISSUE 9): the native-wirec
 #     feeder's sustained ingest rate stays within FEEDER_GATE_RATIO
 #     (default 0.5, i.e. within 2x) of the recorded device

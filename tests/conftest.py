@@ -56,6 +56,11 @@ def _fresh_default_observability():
     # never leak into another's assertions or memory budget
     from cadence_tpu.engine import resident
     resident.reset_all()
+    # serving schedulers own daemon drain threads + pending tickets the
+    # same way: stop them so a leaked drain never flushes into the next
+    # test's registry (a stopped scheduler restarts on its next submit)
+    from cadence_tpu.engine import serving
+    serving.reset_all()
     # quota limiters are held by reference inside frontends the same
     # way: drain one test's consumed tokens so they never shed the next
     # test's first requests

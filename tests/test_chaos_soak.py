@@ -131,6 +131,32 @@ class TestChaosSoak:
                        "cadence_breaker_rejected_total"):
             assert needle in metrics["prometheus"], f"missing {needle}"
 
+    def test_serving_tier_parity_clean_under_chaos(self):
+        """ISSUE 10 satellite: the device-serving transaction tier
+        (CADENCE_TPU_SERVING=1 in every host process) under the same
+        combined wire+store fault matrix — every committed transaction
+        the tier served must have matched the oracle byte for byte
+        (parity-divergence == 0 on every host), the tier must actually
+        have taken traffic, and the pre-registered tpu.serving series
+        must be scrapeable."""
+        chaotic, metrics = _run_cluster(
+            env_extra={"CADENCE_TPU_CHAOS": CHAOS_SPEC,
+                       "CADENCE_TPU_STORE_FAULTS": STORE_FAULT_SPEC,
+                       "CADENCE_TPU_SERVING": "1"},
+            client_chaos=CHAOS_SPEC)
+        baseline, _ = _run_cluster()
+        assert chaotic == baseline, (
+            "serving-tier chaos run diverged from the fault-free run")
+        served = divergence = 0
+        for s in metrics["snapshots"]:
+            scope = s["snapshot"].get("tpu.serving", {})
+            served += scope.get("transactions", 0)
+            divergence += scope.get("parity-divergence", 0)
+        assert served > 0, "serving tier never took a transaction"
+        assert divergence == 0, \
+            "device state diverged from the oracle under chaos"
+        assert "cadence_parity_divergence_total" in metrics["prometheus"]
+
     def test_fault_free_soak_is_reproducible(self):
         """Two fault-free runs agree with each other (the baseline itself
         is deterministic — otherwise the zero-divergence assertion above

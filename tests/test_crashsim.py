@@ -428,6 +428,87 @@ class TestHistorySizeRecovery:
         _zero_findings(wal, stores)
 
 
+class TestServingTierCrash:
+    """ISSUE 10 satellite: a crashpoint mid-transaction must leave the
+    serving tier's resident state either INVALIDATED or parity-clean —
+    never serving a state built from a transaction that half-landed."""
+
+    def test_mid_transaction_crash_then_tail_overwrite_stays_clean(self):
+        from cadence_tpu.engine import crashpoints
+        from cadence_tpu.engine.crashpoints import SimulatedCrash
+        from cadence_tpu.engine.onebox import Onebox
+        from cadence_tpu.utils import metrics as m
+
+        box = Onebox(num_hosts=1, num_shards=2)
+        sched = box.enable_serving()
+        fe = box.frontend
+        fe.register_domain("svc")
+        fe.start_workflow_execution("svc", "wf", "t", "tl")
+        fe.signal_workflow_execution("svc", "wf", "s0", request_id="r0")
+        assert sched.drain(timeout=300.0)
+
+        # crash between the history append and the execution-row commit
+        # point: the orphan-tail shape — history holds a batch the
+        # authoritative state never acknowledged. The serving handoff
+        # runs only AFTER a successful commit, so the tier must never
+        # have seen the phantom batch.
+        crashpoints.install(crashpoints.parse_spec(
+            "site=store.execution.update_workflow,mode=raise"))
+        try:
+            with pytest.raises(SimulatedCrash):
+                fe.signal_workflow_execution("svc", "wf", "s-crash",
+                                             request_id="rc")
+        finally:
+            crashpoints.uninstall()
+
+        # the next committed transaction OVERWRITES the orphan tail at
+        # the same event ids (append_batch node-overwrite semantics);
+        # the content address catches any divergence between what the
+        # resident state covers and what the store now holds
+        fe.signal_workflow_execution("svc", "wf", "s1", request_id="r1")
+        assert sched.drain(timeout=300.0)
+        assert box.metrics.counter(m.SCOPE_TPU_SERVING,
+                                   m.M_SERVING_DIVERGENCE) == 0
+        res = box.route("wf").last_serving_ticket.result(timeout=60)
+        assert res.ok and res.parity_ok
+        r = box.tpu.verify_all()
+        assert r.ok, r.divergent
+        sched.stop()
+
+    def test_crash_before_history_append_is_nothing_applied(self):
+        """The pre-apply crash family (store.history.append_batch fires
+        BEFORE the write): the transaction fails whole, the resident
+        entry stays a valid prefix, the next transaction serves
+        suffix-clean."""
+        from cadence_tpu.engine import crashpoints
+        from cadence_tpu.engine.crashpoints import SimulatedCrash
+        from cadence_tpu.engine.onebox import Onebox
+        from cadence_tpu.utils import metrics as m
+
+        box = Onebox(num_hosts=1, num_shards=2)
+        sched = box.enable_serving()
+        fe = box.frontend
+        fe.register_domain("svc")
+        fe.start_workflow_execution("svc", "wf2", "t", "tl")
+        assert sched.drain(timeout=300.0)
+        crashpoints.install(crashpoints.parse_spec(
+            "site=store.history.append_batch,mode=raise"))
+        try:
+            with pytest.raises(SimulatedCrash):
+                fe.signal_workflow_execution("svc", "wf2", "sx",
+                                             request_id="rx")
+        finally:
+            crashpoints.uninstall()
+        fe.signal_workflow_execution("svc", "wf2", "s1", request_id="r1")
+        assert sched.drain(timeout=300.0)
+        res = box.route("wf2").last_serving_ticket.result(timeout=60)
+        assert res.ok and res.parity_ok and res.path in ("suffix", "cold")
+        assert box.metrics.counter(m.SCOPE_TPU_SERVING,
+                                   m.M_SERVING_DIVERGENCE) == 0
+        assert box.tpu.verify_all().ok
+        sched.stop()
+
+
 class TestPurgeAckRecovery:
     def test_purged_queue_acks_dropped_and_stay_dropped(self, wal):
         """Items re-enqueued after a purge must never be skipped by a

@@ -372,15 +372,18 @@ class TestOverloadGate:
     def test_overload_sheds_aggressor_victim_p99_holds(self):
         """The acceptance bar (deploy/smoke_load.sh): 2-host wire
         cluster, aggressor at 2x quota, victim on the standard mix,
-        seeded wire chaos in every process. Pass iff the victim's p99
-        holds its SLO, >= 90% of aggressor overflow sheds as typed
-        ServiceBusy visible on /metrics, and every completed workflow
-        verifies oracle<->device with zero divergence."""
+        seeded wire chaos in every process AND seeded store faults in
+        the store-server process (the ROADMAP item 4 headroom: chaos was
+        wire-level only). Pass iff the victim's p99 holds its SLO,
+        >= 90% of aggressor overflow sheds as typed ServiceBusy visible
+        on /metrics, and every completed workflow verifies
+        oracle<->device with zero divergence."""
         duration = float(os.environ.get("LOADGEN_DURATION_S", "8"))
         seed = int(os.environ.get("LOADGEN_SEED", "20260803"))
         doc = scenarios.overload_scenario(
             duration_s=duration, seed=seed,
-            chaos_spec=scenarios.DEFAULT_CHAOS_SPEC)
+            chaos_spec=scenarios.DEFAULT_CHAOS_SPEC,
+            store_fault_spec=scenarios.DEFAULT_STORE_FAULT_SPEC)
         adm = doc["admission"]
         agg = adm["aggressor"]
         assert agg["shed"] > 0, doc

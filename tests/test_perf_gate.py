@@ -380,6 +380,44 @@ class TestFeederGate:
                 "the O(new events) path broke")
 
 
+class TestServingGate:
+    """The device-serving transaction tier gate (ISSUE 10): concurrent
+    committed transactions must genuinely micro-batch — at concurrency
+    >= 8 the scheduler coalesces multiple transactions per device
+    launch (factor > 1.5 at saturation), batched p99 stays at or below
+    the unbatched (one-launch-per-transaction) baseline, warm flushes
+    recompile nothing, and every transaction's device payload checksum
+    matches the oracle (parity divergence == 0)."""
+
+    def test_serving_micro_batching_in_process(self):
+        import bench
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+
+        res = bench._serving_suite(DEFAULT_LAYOUT, workflows=32,
+                                   levels=(1, 8))
+        top = next(lv for lv in res["levels"] if lv["concurrency"] == 8)
+        assert top["coalescing_factor"] > 1.5, res["levels"]
+        assert res["parity_divergence"] == 0
+        assert res["warm_recompiles"] == 0, \
+            "a warm serving flush compiled a new from-state executable"
+        assert res["batched_p99_ms"] <= res["unbatched_p99_ms"], (
+            f"micro-batched p99 {res['batched_p99_ms']}ms worse than "
+            f"one-launch-per-transaction {res['unbatched_p99_ms']}ms — "
+            f"the batching window is costing more than it amortizes")
+
+    def test_serving_recorded_in_bench_json(self):
+        """smoke_perf.sh's recorded run must carry the serving suite and
+        hold the same contract (hardware-pinned CI)."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("serving")
+        assert cur, "current bench carries no serving suite"
+        assert cur["parity_divergence"] == 0
+        assert cur["warm_recompiles"] == 0
+        assert cur["coalescing_factor_at_top"] > 1.5
+        assert cur["batched_p99_ms"] <= cur["unbatched_p99_ms"], (
+            f"recorded batched p99 {cur['batched_p99_ms']}ms regressed "
+            f"past unbatched {cur['unbatched_p99_ms']}ms")
+
+
 class TestBaselineGate:
     def _load(self, env):
         return _load_bench(env)
