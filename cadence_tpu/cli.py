@@ -184,6 +184,12 @@ def main(argv=None) -> int:
                           "cache, pass 2 measures the warm hit rate; "
                           "0 = dump current stats only)")
     adm.add_parser("serving")
+    snp = adm.add_parser("snapshot")
+    snp.add_argument("--sweep", action="store_true",
+                     help="run one verify pass (seeding the resident "
+                          "pool) then force-write snapshots for every "
+                          "resident workflow before the rollup — the "
+                          "warm-the-next-restart verb")
 
     # WAL tools (adminDBScan/adminDBClean analogs over the one backend)
     wal_grp = sub.add_parser("wal").add_subparsers(dest="cmd", required=True)
@@ -528,6 +534,22 @@ def main(argv=None) -> int:
             # the device-serving tier rollup (engine/serving.py):
             # coalescing factor, queue, path mix, parity counters
             _emit(admin.serving())
+        elif args.cmd == "snapshot":
+            # snapshot-tier rollup (engine/snapshot.py); --sweep first
+            # seeds the resident pool via one verify pass and persists a
+            # record per resident workflow (checksum-gated), then the
+            # WAL carries a warm start for the next recovery
+            out = {}
+            if args.sweep:
+                r = admin.verify()
+                sweep = box.tpu.snapshot_sweep(force=True)
+                out["sweep"] = {"verified_on_device":
+                                r.verified_on_device,
+                                "considered": sweep.considered,
+                                "written": sweep.written,
+                                "skipped_checksum":
+                                sweep.skipped_checksum}
+            _emit({**out, **admin.snapshot()})
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of

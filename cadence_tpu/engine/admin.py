@@ -194,6 +194,52 @@ class AdminHandler:
                                  if cache.ladder is not None else 0),
         }
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Snapshot-tier introspection (`admin snapshot` CLI verb,
+        mirroring `admin resident`): per-store rollup of record count,
+        bytes, the staleness distribution (batches the stored history
+        has appended past each snapshot), and the write/hydrate/ignore
+        counters — the operator's view of how warm the next restart
+        will be."""
+        self._authorize("snapshot")
+        from ..utils import metrics as m
+        from .snapshot import enabled
+        store = self.box.stores.snapshot
+        hs = self.box.stores.history
+        staleness: list = []
+        for key, rec in store.items():
+            stored = hs.batch_count(*key)
+            if stored >= rec.batch_count:
+                staleness.append(stored - rec.batch_count)
+        staleness.sort()
+
+        def pct(q: float) -> int:
+            return staleness[min(len(staleness) - 1,
+                                 int(len(staleness) * q))] if staleness \
+                else 0
+
+        reg = self.box.metrics
+        snapper = self.box.tpu.snapshotter()
+        return {
+            "enabled": enabled(),
+            **store.stats(),
+            "staleness_batches": {
+                "p50": pct(0.5), "p99": pct(0.99),
+                "max": staleness[-1] if staleness else 0,
+            },
+            "min_events": snapper.min_events,
+            "every_events": snapper.every_events,
+            "writes": reg.counter(m.SCOPE_TPU_SNAPSHOT, m.M_SNAP_WRITES),
+            "checksum_skips": reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                                          m.M_SNAP_CHECKSUM_SKIPS),
+            "hydrates": reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                                    m.M_SNAP_HYDRATES),
+            "ignored_stale": reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                                         m.M_SNAP_IGNORED_STALE),
+            "ignored_torn": reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                                        m.M_SNAP_IGNORED_TORN),
+        }
+
     def serving(self) -> Dict[str, Any]:
         """Device-serving tier introspection (`admin serving` CLI verb):
         the micro-batching transaction scheduler's knobs, queue depth,

@@ -207,7 +207,11 @@ class PackCache:
     def encode(self, key: Tuple[str, str, str], batches) -> np.ndarray:
         """Encoded [n, L] rows for this key's history (single lineage,
         batches in store order). Callers must treat the result as
-        immutable — it is the cached array."""
+        immutable — it is the cached array. A suffix-seeded entry
+        (base_events > 0, engine/snapshot.py hydration) cannot serve a
+        FULL encode — it covers only the post-snapshot rows — so it
+        counts as a miss here and is upgraded to a base-0 entry by the
+        full pack."""
         from ..ops.encode import NUM_LANES, encode_batches_resumable
 
         m = self._m
@@ -217,12 +221,12 @@ class PackCache:
             return np.zeros((0, NUM_LANES), dtype=np.int64)
         entry = self.lru.get(key)
         if entry is not None:
-            rows, address, interner_map = entry
+            rows, address, interner_map, base = entry
             relation = address_relation(address, batches)
-            if relation == "exact":
+            if base == 0 and relation == "exact":
                 scope.inc(m.M_CACHE_HITS)
                 return rows
-            if relation == "prefix":
+            if base == 0 and relation == "prefix":
                 # valid prefix: pack only the appended suffix
                 suffix, new_map = encode_batches_resumable(
                     batches[address.batch_count:], interner_map)
@@ -245,13 +249,16 @@ class PackCache:
         store history. Returns None when the entry is missing or covers
         different bytes (caller falls back to the full-read path); on
         success the cache is re-addressed at `new_address` so the next
-        chained append extends it again."""
+        chained append extends it again. Works identically on a
+        suffix-seeded entry (the base offset rides along), which is what
+        keeps a snapshot-hydrated workflow's serving chain O(suffix)
+        without the prefix ever being packed."""
         from ..ops.encode import encode_batches_resumable
 
         entry = self.lru.get(key)
         if entry is None:
             return None
-        rows, address, interner_map = entry
+        rows, address, interner_map, base = entry
         if address != prefix_address:
             return None
         suffix, new_map = encode_batches_resumable(new_batches,
@@ -259,26 +266,83 @@ class PackCache:
         self.metrics.inc(self._m.SCOPE_PACK_CACHE,
                          self._m.M_CACHE_SUFFIX_PACKS)
         self._put(key, np.concatenate([rows, suffix]), new_address,
-                  new_map)
+                  new_map, base_events=base)
         return suffix
 
     def encode_suffix(self, key: Tuple[str, str, str], batches,
                       from_batch: int) -> np.ndarray:
         """Only the rows of batches[from_batch:] — the resident-state
         append path (engine/resident.py): the device replays JUST the
-        appended lanes against the HBM-resident state. Encoding goes
-        through encode() so the suffix bytes are guaranteed identical to
-        the corresponding slice of a full pack (resumed-interner
-        contract) and the pack-cache counters keep telling the truth
-        about how the lanes were produced (hit / suffix-pack / miss)."""
-        from ..ops.encode import history_length
+        appended lanes against the HBM-resident state. Suffix bytes are
+        guaranteed identical to the corresponding slice of a full pack
+        (resumed-interner contract). A suffix-seeded entry
+        (base_events > 0) serves any slice at or past its base without
+        ever materializing the prefix rows — the snapshot tier's
+        O(suffix) host-side half; everything else routes through
+        encode() so the counters keep telling the truth about how the
+        lanes were produced (hit / suffix-pack / miss)."""
+        from ..ops.encode import encode_batches_resumable, history_length
 
+        start = history_length(batches[:from_batch])
+        entry = self.lru.get(key)
+        if entry is not None and entry[3] > 0:
+            rows, address, interner_map, base = entry
+            relation = address_relation(address, batches)
+            if relation in ("exact", "prefix") and start >= base:
+                if relation == "prefix":
+                    suffix, new_map = encode_batches_resumable(
+                        batches[address.batch_count:], interner_map)
+                    rows = np.concatenate([rows, suffix])
+                    self.metrics.inc(self._m.SCOPE_PACK_CACHE,
+                                     self._m.M_CACHE_SUFFIX_PACKS)
+                    self._put(key, rows, content_address(batches),
+                              new_map, base_events=base)
+                else:
+                    self.metrics.inc(self._m.SCOPE_PACK_CACHE,
+                                     self._m.M_CACHE_HITS)
+                return rows[start - base:]
+            # stale or pre-base request: fall through to the full path
         rows = self.encode(key, batches)
-        return rows[history_length(batches[:from_batch]):]
+        return rows[start:]
+
+    def seed_suffix(self, key: Tuple[str, str, str],
+                    address: ContentAddress, interner_map,
+                    base_events: int) -> None:
+        """Install a ZERO-ROW entry anchored at a snapshot's content
+        address with its persisted interner (engine/snapshot.py
+        hydration): subsequent encode_suffix/encode_append calls for
+        this key extend from here — byte-identical to a resumed full
+        pack — without the prefix lanes ever existing on this host."""
+        from ..ops.encode import NUM_LANES
+
+        self._put(key, np.zeros((0, NUM_LANES), dtype=np.int64),
+                  address, dict(interner_map),
+                  base_events=int(base_events))
+
+    def interner_for(self, key: Tuple[str, str, str],
+                     address: ContentAddress):
+        """The cached interner snapshot at exactly `address` (None
+        otherwise) — the snapshot writer persists it so hydration can
+        resume suffix encoding without the prefix."""
+        entry = self.lru.get(key)
+        if entry is None or entry[1] != address:
+            return None
+        return entry[2]
+
+    def events_for(self, key: Tuple[str, str, str],
+                   address: ContentAddress) -> Optional[int]:
+        """Total packed event rows covered by the entry at `address`
+        (base offset + cached rows); None when the cache holds nothing
+        for that address."""
+        entry = self.lru.get(key)
+        if entry is None or entry[1] != address:
+            return None
+        return int(entry[3] + entry[0].shape[0])
 
     def _put(self, key, rows, address: ContentAddress,
-             interner_map) -> None:
-        evicted = self.lru.put(key, (rows, address, interner_map))
+             interner_map, base_events: int = 0) -> None:
+        evicted = self.lru.put(key, (rows, address, interner_map,
+                                     int(base_events)))
         if evicted:
             self.metrics.inc(self._m.SCOPE_PACK_CACHE,
                              self._m.M_CACHE_EVICTIONS, evicted)

@@ -263,8 +263,10 @@ def read_log(path: str) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 #: current WAL record-schema version. History: v1 = round-2 record set;
-#: v2 = domain records carry status/description/archival-uri fields.
-WAL_VERSION = 2
+#: v2 = domain records carry status/description/archival-uri fields;
+#: v3 = the persisted mutable-state snapshot tier's "snap" records
+#: (engine/snapshot.py) join the record set.
+WAL_VERSION = 3
 
 
 def version_record() -> dict:
@@ -285,8 +287,15 @@ def _migrate_1_to_2(rec: dict) -> dict:
     return rec
 
 
+def _migrate_2_to_3(rec: dict) -> dict:
+    """v2→v3: purely additive — v3 introduces the snapshot tier's "snap"
+    record type, which no v2 log can contain; existing record bodies are
+    already current-format."""
+    return rec
+
+
 #: from-version → record transform producing from-version+1 records
-_MIGRATIONS = {1: _migrate_1_to_2}
+_MIGRATIONS = {1: _migrate_1_to_2, 2: _migrate_2_to_3}
 
 
 def wal_version(records: List[dict]) -> int:
@@ -395,6 +404,48 @@ def delete_run_record(domain_id: str, workflow_id: str, run_id: str) -> dict:
     return {"t": "delw", "d": domain_id, "w": workflow_id, "r": run_id}
 
 
+def snapshot_record(rec) -> dict:
+    """Persisted mutable-state snapshot (engine/snapshot.SnapshotRecord
+    → WAL "snap" record, a v3 type): the device ReplayState row blob,
+    canonical payload, content address, interner snapshot, and layout
+    signature — everything a cold path needs to hydrate + replay only
+    the since-snapshot suffix."""
+    import numpy as _np
+    return {
+        "t": "snap", "d": rec.key[0], "w": rec.key[1], "r": rec.key[2],
+        "n": int(rec.batch_count), "crc": int(rec.last_batch_crc),
+        "ev": int(rec.events), "hs": int(rec.history_size),
+        "b": int(rec.branch),
+        "pay": base64.b64encode(
+            _np.asarray(rec.payload, dtype=_np.int64).tobytes()
+        ).decode("ascii"),
+        "blob": base64.b64encode(rec.state_blob).decode("ascii"),
+        "bc": int(rec.blob_crc), "im": dict(rec.interner),
+        "lay": list(rec.layout), "sv": int(rec.version),
+    }
+
+
+def snapshot_from_record(rec: dict):
+    """Inverse of snapshot_record; raises on malformed bodies (recovery
+    catches and IGNORES — a doctored snapshot must never wedge a
+    restart, it just costs that run its warm start)."""
+    import numpy as _np
+
+    from .snapshot import SnapshotRecord
+    return SnapshotRecord(
+        key=(rec["d"], rec["w"], rec["r"]),
+        batch_count=int(rec["n"]), last_batch_crc=int(rec["crc"]),
+        events=int(rec["ev"]), history_size=int(rec["hs"]),
+        branch=int(rec["b"]),
+        payload=_np.frombuffer(base64.b64decode(rec["pay"]),
+                               dtype=_np.int64).copy(),
+        state_blob=base64.b64decode(rec["blob"]),
+        blob_crc=int(rec["bc"]),
+        interner={str(k): int(v) for k, v in rec["im"].items()},
+        layout=tuple(int(v) for v in rec["lay"]),
+        version=int(rec["sv"]))
+
+
 def config_record(key: str, value, domain=None) -> dict:
     """Dynamic-config write (the configstore analog): the CLI persists
     operator config changes so every later invocation sees them."""
@@ -489,6 +540,9 @@ class RecoveryReport:
     #: recovery rebuilder, not just the verifier
     device_rebuilt: int = 0
     rebuild_fallback: int = 0
+    #: runs whose rebuild hydrated a persisted snapshot and replayed
+    #: only the since-snapshot suffix (the warm-restart counter)
+    snapshot_hydrated: int = 0
     device_verified: int = 0
     oracle_fallback: int = 0
     divergent: List[Tuple[str, str, str]] = field(default_factory=list)
@@ -577,9 +631,22 @@ def recover_stores(path: str, verify_on_device: bool = True,
             stores.history.set_current_branch(rec["d"], rec["w"], rec["r"],
                                               rec["b"])
         elif t == "delw":
-            # retention tombstone: the run's history and snapshot stay dead
+            # retention tombstone: the run's history and snapshot stay
+            # dead (delete_run's snapshot-store hook drops any persisted
+            # device-state snapshot too — derived invalidation)
             stores.history.delete_run(rec["d"], rec["w"], rec["r"])
             stores.execution.delete_workflow(rec["d"], rec["w"], rec["r"])
+        elif t == "snap":
+            # persisted device-state snapshot: install the LATEST record
+            # per run. Replay order makes invalidation derived state — a
+            # later tail overwrite / branch switch / delete record drops
+            # it through the same history-store hooks the live engine
+            # uses. A malformed body is ignored (that run simply cold
+            # starts); hydration re-validates blob CRC + layout anyway.
+            try:
+                stores.snapshot.restore(snapshot_from_record(rec))
+            except Exception:
+                pass
         elif t == "cfg":
             stores.recovered_config.append(
                 (rec["k"], rec["v"], rec.get("dom")))
@@ -681,9 +748,17 @@ def _rebuild_executions(stores: Stores, verify_on_device: bool,
     from ..core.checksum import DEFAULT_LAYOUT
     layout = layout if layout is not None else DEFAULT_LAYOUT
     rebuilder = DeviceRebuilder(layout)
+    # warm restart: the device rebuild consults the recovered snapshot
+    # store — a run with a valid snapshot hydrates the persisted
+    # ReplayState row and replays ONLY the since-snapshot suffix
+    # (engine/snapshot.py), instead of re-encoding + re-scanning its
+    # whole history. Oracle-mode recovery (rebuild_on_device=False)
+    # ignores snapshots entirely: no device state to hydrate into.
+    rebuilder.snapshots = stores.snapshot
     states = rebuilder.rebuild(jobs, on_device=rebuild_on_device) if jobs else []
     report.device_rebuilt = rebuilder.stats.device
     report.rebuild_fallback = rebuilder.stats.oracle_fallback
+    report.snapshot_hydrated = rebuilder.stats.snapshot_seeded
 
     for key, ms in zip(keys, states):
         current_branch = stores.history.get_current_branch(*key)

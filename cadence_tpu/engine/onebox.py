@@ -89,6 +89,10 @@ class Onebox:
         # cache so the host side is O(suffix) too
         self.rebuilder.resident = self.tpu.resident
         self.rebuilder.pack_cache = self.tpu.pack_cache
+        # the rebuilder also consults the durable snapshot tier
+        # (engine/snapshot.py): a reset/recovery rebuild of a
+        # snapshotted workflow hydrates + replays only the suffix
+        self.rebuilder.snapshots = self.stores.snapshot
         # one consistent-query registry for the cluster (shard movement
         # within the box keeps waiters reachable)
         from .query import QueryRegistry

@@ -130,6 +130,22 @@ class HistoryStore:
         self._branches: Dict[Tuple[str, str, str], List[List[List[HistoryEvent]]]] = {}
         self._current: Dict[Tuple[str, str, str], int] = {}
         self._wal = None
+        #: SnapshotStore back-reference (Stores wires it): history
+        #: mutations that rewrite bytes under a snapshot's content
+        #: address — tail overwrite at/before the snapshot point, NDC
+        #: branch switch, run deletion — drop the snapshot HERE, the one
+        #: place every writer funnels through. Recovery replays these
+        #: same records in the same order, so the derived invalidation
+        #: converges without tombstone records.
+        self._snapshots = None
+        #: lazily-extended per-batch serialized sizes ((key, branch) ->
+        #: [bytes per batch], always a valid prefix of the branch):
+        #: serialized_size() extends it O(appended) on the append-only
+        #: fast path and any overwrite drops it — so the snapshot writer
+        #: reads the mutable-state history_size without re-serializing
+        #: the whole branch per record
+        self._size_cache: Dict[Tuple[Tuple[str, str, str], int],
+                               List[int]] = {}
 
     def append_batch(self, domain_id: str, workflow_id: str, run_id: str,
                      events: List[HistoryEvent],
@@ -166,17 +182,32 @@ class HistoryStore:
                     )
                 if first < expected:
                     # overwrite: drop the tail from `first` on
+                    truncated_last = False
                     while target and target[-1][0].id >= first:
                         target.pop()
                     if target and target[-1][-1].id >= first:
                         kept = [e for e in target[-1] if e.id < first]
                         if kept:
                             target[-1] = kept
+                            truncated_last = True
                         else:
                             target.pop()
                     if target and target[-1][-1].id + 1 != first:
                         raise ConditionFailedError(
                             f"history overwrite leaves a gap before {first}")
+                    self._size_cache.pop((key, index), None)
+                    if self._snapshots is not None \
+                            and (branch is None or index ==
+                                 self._current.get(key, 0)):
+                        # a snapshot covering any rewritten batch is
+                        # dead (its tail CRC no longer matches stored
+                        # bytes); one strictly before the rewrite point
+                        # remains a valid prefix and survives. A
+                        # mid-batch truncation rewrote the LAST KEPT
+                        # batch too, so the boundary moves back one.
+                        self._snapshots.invalidate_overwrite(
+                            key, len(target) - (1 if truncated_last
+                                                else 0))
             target.append(list(events))
             if self._wal is not None:
                 from .durability import history_record, history_record_from_blob
@@ -214,8 +245,14 @@ class HistoryStore:
 
     def set_current_branch(self, domain_id: str, workflow_id: str,
                            run_id: str, branch: int) -> None:
+        key = (domain_id, workflow_id, run_id)
         with self._lock:
-            self._current[(domain_id, workflow_id, run_id)] = branch
+            switched = self._current.get(key, 0) != branch
+            self._current[key] = branch
+            if switched and self._snapshots is not None:
+                # NDC branch switch: the snapshot's lineage is no longer
+                # what consumers replay (same rule as the resident cache)
+                self._snapshots.invalidate_branch_switch(key)
             if self._wal is not None:
                 from .durability import current_branch_record
                 self._wal.append(current_branch_record(
@@ -234,6 +271,10 @@ class HistoryStore:
         with self._lock:
             existed = self._branches.pop(key, None) is not None
             self._current.pop(key, None)
+            for cache_key in [k for k in self._size_cache if k[0] == key]:
+                del self._size_cache[cache_key]
+            if self._snapshots is not None:
+                self._snapshots.drop(key)
             if existed and self._wal is not None:
                 from .durability import delete_run_record
                 self._wal.append(delete_run_record(domain_id, workflow_id,
@@ -266,6 +307,83 @@ class HistoryStore:
         return [e for b in self.read_batches(domain_id, workflow_id, run_id,
                                              branch)
                 for e in b]
+
+    def serialized_size(self, domain_id: str, workflow_id: str,
+                        run_id: str, branch: Optional[int] = None) -> int:
+        """The branch's mutable-state history_size: the sum of each
+        batch's serialized bytes (the invariant walcheck audits rebuilt
+        states against). Lazily cached per batch — the append-only fast
+        path serializes only batches the cache hasn't seen; overwrites
+        drop the cache. The snapshot writer persists this next to the
+        device state so a warm restart recovers history-size accounting
+        in O(suffix) instead of re-serializing the prefix."""
+        from ..core.codec import serialize_history
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branches = self._branches.get(key)
+            if branches is None:
+                raise EntityNotExistsError(
+                    f"no history for {workflow_id}/{run_id}")
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                raise EntityNotExistsError(f"no branch {index} for {key}")
+            target = branches[index]
+            sizes = self._size_cache.setdefault((key, index), [])
+            if len(sizes) > len(target):
+                del sizes[:]  # stale cache (belt and braces)
+            for b in target[len(sizes):]:
+                sizes.append(len(serialize_history([HistoryBatch(
+                    domain_id=domain_id, workflow_id=workflow_id,
+                    run_id=run_id, events=list(b))])))
+            return sum(sizes)
+
+    def batch_count(self, domain_id: str, workflow_id: str, run_id: str,
+                    branch: Optional[int] = None) -> int:
+        """Number of stored batches on a branch — 0 for unknown runs.
+        The O(1) probe the batch-range consumers (snapshot hydration,
+        the serving chain-break fallback) pair with read_batches_range
+        so a cold path never touches the prefix."""
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branches = self._branches.get(key)
+            if branches is None:
+                return 0
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                return 0
+            return len(branches[index])
+
+    def read_batches_range(self, domain_id: str, workflow_id: str,
+                           run_id: str, from_batch: int,
+                           branch: Optional[int] = None
+                           ) -> List[List[HistoryEvent]]:
+        """Only batches[from_batch:] — the batch-range read
+        (ReadHistoryBranch with a minNodeID floor): a consumer holding a
+        snapshot or resident state at batch count c fetches from c-1
+        (the boundary batch, for the content-address CRC check) and
+        never deserializes the prefix."""
+        key = (domain_id, workflow_id, run_id)
+        with self._lock:
+            branches = self._branches.get(key)
+            if branches is None:
+                raise EntityNotExistsError(
+                    f"no history for {workflow_id}/{run_id}")
+            index = self._current.get(key, 0) if branch is None else branch
+            if index >= len(branches):
+                raise EntityNotExistsError(f"no branch {index} for {key}")
+            return [list(b) for b in branches[index][max(0, from_batch):]]
+
+    def as_history_batches_range(self, domain_id: str, workflow_id: str,
+                                 run_id: str, from_batch: int,
+                                 branch: Optional[int] = None
+                                 ) -> List[HistoryBatch]:
+        """read_batches_range in the replay-input shape."""
+        return [
+            HistoryBatch(domain_id=domain_id, workflow_id=workflow_id,
+                         run_id=run_id, events=b)
+            for b in self.read_batches_range(domain_id, workflow_id,
+                                             run_id, from_batch, branch)
+        ]
 
     def as_history_batches(self, domain_id: str, workflow_id: str, run_id: str,
                            branch: Optional[int] = None) -> List[HistoryBatch]:
@@ -999,10 +1117,17 @@ class Stores:
     queue: QueueStore = field(default_factory=QueueStore)
     shard_tasks: ShardTaskQueues = field(default_factory=ShardTaskQueues)
     execution: ExecutionStore = None  # type: ignore[assignment]
+    snapshot: object = None  # SnapshotStore (engine/snapshot.py)
 
     def __post_init__(self) -> None:
         if self.execution is None:
             self.execution = ExecutionStore(self.shard)
+        if self.snapshot is None:
+            from .snapshot import SnapshotStore
+            self.snapshot = SnapshotStore()
+        # content-address invalidation rides the history store: every
+        # writer that rewrites bytes under a snapshot funnels through it
+        self.history._snapshots = self.snapshot
 
     def attach_wal(self, wal) -> None:
         """Route every durable mutation through one write-ahead log
@@ -1017,5 +1142,5 @@ class Stores:
         sequence numbers to make replay order-insensitive."""
         self.wal = wal
         for store in (self.shard, self.history, self.domain, self.queue,
-                      self.execution):
+                      self.execution, self.snapshot):
             store._wal = wal

@@ -59,6 +59,10 @@ class RebuildStats:
     #: exact hit hydrates straight from the pinned state (zero replay),
     #: a suffix hit replays only the appended batches
     resident: int = 0
+    #: jobs whose resident entry was seeded from a PERSISTED snapshot
+    #: (engine/snapshot.py) — the warm-restart path: hydrate + replay
+    #: only the since-snapshot suffix, never the full history
+    snapshot_seeded: int = 0
     kernel_errors: Dict[int, int] = field(default_factory=dict)
 
     def merge(self, other: "RebuildStats") -> None:
@@ -66,6 +70,7 @@ class RebuildStats:
         self.oracle_fallback += other.oracle_fallback
         self.ladder += other.ladder
         self.resident += other.resident
+        self.snapshot_seeded += other.snapshot_seeded
         for code, n in other.kernel_errors.items():
             self.kernel_errors[code] = self.kernel_errors.get(code, 0) + n
 
@@ -109,11 +114,26 @@ class DeviceRebuilder:
         #: HBM-resident state cache to consult before full replay
         #: (Onebox wires the cluster's shared cache here — the same one
         #: TPUReplayEngine.verify_all seeds); None skips the consult
+        #: unless a snapshot store is wired, which lazily owns one
         self.resident = None
-        #: pack cache whose suffix path encodes resident appends O(suffix)
-        #: (Onebox wires the engine's; without one, appends fall back to
-        #: a full re-encode sliced at the prefix — correct, O(history))
-        self.pack_cache = None
+        #: pack cache whose suffix path encodes resident appends
+        #: O(suffix). Onebox wires the engine's shared cache; standalone
+        #: rebuilders (recovery, the reset-prefix path) OWN one, so a
+        #: suffix encode always resumes an interner instead of paying a
+        #: full re-encode sliced at the prefix — every consumer is
+        #: O(suffix) on the host side too
+        from .cache import PackCache
+        self.pack_cache = PackCache()
+        #: persisted-snapshot store (engine/snapshot.SnapshotStore):
+        #: recovery wires the recovered bundle's store here, turning a
+        #: host restart into hydrate + replay-since-snapshot instead of
+        #: a full-history replay storm
+        self.snapshots = None
+        #: key -> (snapshot batch count, persisted history_size) for
+        #: seeds made this rebuild: hydration recovers history-size
+        #: accounting as snapshot size + suffix bytes — O(suffix),
+        #: never a prefix re-serialization
+        self._snap_sizes: Dict[tuple, Tuple[int, int]] = {}
         #: max jobs per device launch (bounds the [W, E, L] corpus the
         #: same way the replay engine's chunking does)
         self.chunk_jobs = (chunk_jobs if chunk_jobs else
@@ -160,6 +180,12 @@ class DeviceRebuilder:
 
         if not jobs:
             return []
+        # persisted-snapshot consult FIRST (warm restart): jobs with a
+        # valid snapshot hydrate the durable ReplayState row into the
+        # resident pool (seeding the pack cache's interner at the
+        # snapshot point), so the resident prepass below serves them as
+        # exact/suffix hits — replaying only the since-snapshot suffix
+        self._seed_from_snapshots(jobs)
         # resident consult: jobs whose key is pinned in the HBM cache
         # rebuild from the resident state — an exact hit hydrates with
         # ZERO replay, a suffix hit replays only the appended batches
@@ -330,6 +356,41 @@ class DeviceRebuilder:
         merged.update(zip(positions, device_out))
         return [merged[i] for i in range(len(merged))]
 
+    def _seed_from_snapshots(self, jobs) -> None:
+        """Hydrate persisted snapshots into the resident pool for every
+        job the pool doesn't already cover. A rebuilder without a wired
+        resident cache (standalone recovery) lazily owns one — the
+        hydrated states have to live somewhere the prepass can see."""
+        from . import resident as resident_mod
+        from . import snapshot as snapshot_mod
+
+        if self.snapshots is None or not snapshot_mod.enabled() \
+                or not resident_mod.enabled() or not len(self.snapshots):
+            return
+        if self.resident is None:
+            from .resident import ResidentStateCache
+            self.resident = ResidentStateCache(self.layout,
+                                               ladder=self.ladder,
+                                               registry=self.metrics)
+        from .cache import address_relation
+        for batches, _entry in jobs:
+            if not batches:
+                continue
+            b0 = batches[0]
+            key = (b0.domain_id, b0.workflow_id, b0.run_id)
+            entry = self.resident.entry_for(key)
+            if entry is not None and address_relation(
+                    entry.address, batches) in ("exact", "prefix"):
+                continue  # the pool already covers this lineage
+            if snapshot_mod.seed_from_batches(
+                    self.snapshots, self.resident, self.pack_cache, key,
+                    batches, self.layout, self.metrics):
+                self.stats.snapshot_seeded += 1
+                rec = self.snapshots.get(key)
+                if rec is not None:
+                    self._snap_sizes[key] = (rec.batch_count,
+                                             rec.history_size)
+
     def _resident_prepass(self, jobs) -> Dict[int, MutableState]:
         """Resolve jobs out of the resident state cache: returns
         {job position: hydrated MutableState} for every job it could
@@ -344,7 +405,7 @@ class DeviceRebuilder:
         if cache is None or not resident_mod.enabled():
             return {}
         from ..utils import metrics as m
-        pre: Dict[int, MutableState] = {}
+        resolved: List[tuple] = []  # (pos, key, batches, entry, rentry)
         suffix_items = []
         suffix_jobs = []
         for pos, (batches, entry) in enumerate(jobs):
@@ -357,9 +418,7 @@ class DeviceRebuilder:
                 continue
             kind, rentry = hit
             if kind == "exact":
-                ms = self._hydrate_resident(rentry, batches, entry)
-                if ms is not None:
-                    pre[pos] = ms
+                resolved.append((pos, key, batches, entry, rentry))
             else:
                 suffix_items.append((key, rentry, batches))
                 suffix_jobs.append((pos, batches, entry))
@@ -374,9 +433,8 @@ class DeviceRebuilder:
                     continue  # entry invalidated; device path takes it
                 hit2 = cache.lookup(key, batches, authoritative=False)
                 if hit2 is not None and hit2[0] == "exact":
-                    ms = self._hydrate_resident(hit2[1], batches, entry)
-                    if ms is not None:
-                        pre[pos] = ms
+                    resolved.append((pos, key, batches, entry, hit2[1]))
+        pre = self._hydrate_resolved(resolved)
         if pre:
             self.stats.device += len(pre)
             self.stats.resident += len(pre)
@@ -384,18 +442,65 @@ class DeviceRebuilder:
             scope.inc(m.M_DEVICE_REBUILDS, len(pre))
         return pre
 
-    def _hydrate_resident(self, rentry, batches,
-                          entry) -> Optional[MutableState]:
-        """Hydrate a MutableState from a pinned (possibly ladder-widened)
-        state row; verified against the cache's canonical payload."""
+    def _hydrate_resolved(self, resolved) -> Dict[int, MutableState]:
+        """Hydrate MutableStates from resident-served rows, verified
+        against each entry's canonical payload. Base-rung rows hydrate
+        in BATCHES: chunks stack into one pytree and pay ONE device_get
+        — a restart hydrating thousands of rows must not pay a per-key
+        device round-trip per workflow. Ladder-widened rows (different
+        leaf shapes) read back individually — the rare case."""
         import jax
 
-        arrs = jax.device_get(rentry.state)
-        ms = self._hydrate(arrs, 0, batches, entry)
-        if ms is None or not (payload_row(ms, self.layout)
-                              == rentry.payload).all():
+        from ..ops.state import init_state, layout_of
+        from .resident import ResidentStateCache, _bucket
+
+        pre: Dict[int, MutableState] = {}
+
+        def hydrate_one(arrs, row, pos, key, batches, entry, rentry):
+            ms = self._hydrate(arrs, row, batches, entry,
+                               known_size=self._known_size(key, batches))
+            if ms is not None and (payload_row(ms, self.layout)
+                                   == rentry.payload).all():
+                pre[pos] = ms
+
+        base = [r for r in resolved if r[4].rung == 0]
+        for lo in range(0, len(base), 64):
+            group = base[lo:lo + 64]
+            states = [g[4].state for g in group]
+            if len(states) == 1:
+                arrs = jax.device_get(states[0])
+            else:
+                Wp = _bucket(len(states), 8)
+                if Wp > len(states):
+                    states = states + [init_state(Wp - len(states),
+                                                  layout_of(states[0]))]
+                arrs = jax.device_get(
+                    ResidentStateCache._stack_rows(states))
+            for j, (pos, key, batches, entry, rentry) in enumerate(group):
+                hydrate_one(arrs, j if len(group) > 1 else 0,
+                            pos, key, batches, entry, rentry)
+        for pos, key, batches, entry, rentry in resolved:
+            if rentry.rung == 0:
+                continue
+            arrs = jax.device_get(rentry.state)
+            hydrate_one(arrs, 0, pos, key, batches, entry, rentry)
+        return pre
+
+    def _known_size(self, key, batches) -> Optional[int]:
+        """history_size recovered from a persisted snapshot: the stored
+        accounting plus the since-snapshot suffix bytes — O(suffix).
+        None (full recomputation) when no snapshot seeded this key or
+        the batches involve a continue-as-new chain (accounting resets
+        at the run boundary)."""
+        info = self._snap_sizes.get(key)
+        if info is None:
             return None
-        return ms
+        n, size = info
+        if n > len(batches) or any(b.new_run_events for b in batches):
+            return None
+        from ..core.codec import serialize_history
+        return size + sum(len(serialize_history([b]))
+                          for b in batches[n:])
 
     @staticmethod
     def _oracle_rebuild(batches, entry) -> MutableState:
@@ -409,11 +514,16 @@ class DeviceRebuilder:
         return ms
 
     def _hydrate(self, arrs, i: int, batches: Sequence[HistoryBatch],
-                 entry: Optional[DomainEntry]) -> Optional[MutableState]:
+                 entry: Optional[DomainEntry],
+                 known_size: Optional[int] = None
+                 ) -> Optional[MutableState]:
         """Dense ReplayState row + host-side event attrs → MutableState.
 
         For a continue-as-new chain the device row ends in the LAST run's
-        state; hydration therefore works on the last run's batches."""
+        state; hydration therefore works on the last run's batches.
+        `known_size` short-circuits the history-size recomputation (a
+        per-batch re-serialization) with the snapshot-recovered value —
+        the warm-restart path's O(suffix) accounting."""
         runs: List[List[HistoryBatch]] = [[]]
         for b in batches:
             runs[-1].append(b)
@@ -435,7 +545,10 @@ class DeviceRebuilder:
             return None
         ms = sb.ms
         ms.transfer_tasks, ms.timer_tasks, ms.cross_cluster_tasks = [], [], []
-        ms.history_size = _rebuilt_history_size(last_run, last_run[0].run_id)
+        ms.history_size = (known_size
+                           if known_size is not None and len(runs) == 1
+                           else _rebuilt_history_size(
+                               last_run, last_run[0].run_id))
         info = ms.execution_info
 
         # scan-dependent execution scalars from the device

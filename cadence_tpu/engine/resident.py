@@ -270,6 +270,12 @@ class ResidentStateCache:
         with self._lock:
             return sum(len(s) for s in self._slices)
 
+    def keys(self) -> List[tuple]:
+        """Every pinned workflow key across the shard slices (the
+        snapshot sweep's iteration seam, engine/snapshot.Snapshotter)."""
+        with self._lock:
+            return [k for sl in self._slices for k in sl.keys()]
+
     @property
     def resident_bytes(self) -> int:
         with self._lock:

@@ -223,6 +223,9 @@ class ServingScheduler:
                           else int(os.environ.get(QUEUE_ENV,
                                                   str(DEFAULT_QUEUE))))
         self.variants = compile_cache.DEFAULT_VARIANTS
+        #: injected read seams (bench/tests) disable the batch-range
+        #: fast path below — a custom reader owns its own store model
+        self._injected_reads = read_batches is not None
         self._read_batches = read_batches or self._store_batches
         self._read_live_row = read_live_row or self._store_live_row
         self._cv = threading.Condition()
@@ -467,7 +470,6 @@ class ServingScheduler:
     # -- the flush ----------------------------------------------------------
 
     def _flush(self, batch: List[_Pending]) -> None:
-        scope = self._scope()
         t_flush = time.perf_counter()
         self.metrics.observe(m.SCOPE_TPU_SERVING, m.M_SERVING_BATCH_SIZE,
                              float(sum(1 + i.coalesced for i in batch)),
@@ -497,63 +499,10 @@ class ServingScheduler:
                         suffix.append((item.key, entry, (rows, new_addr)))
                         suffix_items.append(item)
                         continue
-            try:
-                batches = self._read_batches(item.key)
-            except Exception as exc:
-                self._resolve(item, ServingResult(
-                    ok=False, error=f"read: {type(exc).__name__}"))
-                continue
-            if batches is None or not batches:
-                # multi-branch tree (NDC branch switch) or vanished run:
-                # the resident tier never serves across those — drop any
-                # pinned state and leave the device twin to the full
-                # verify path
-                self.resident.invalidate(item.key)
-                scope.inc(m.M_SERVING_BYPASSED)
-                self._resolve(item, ServingResult(ok=False, path="bypass",
-                                                  error="multi-branch"))
-                continue
-            if batch_crc(batches[-1]) != item.tail_crc:
-                # the store tail moved past the enqueued transaction: a
-                # newer commit landed between submit and drain. Re-read
-                # the live row; if history and execution row disagree
-                # (mid-commit window) requeue instead of comparing torn
-                # state against the device
-                try:
-                    row, br, next_id = self._read_live_row(item.key)
-                except Exception as exc:
-                    self._resolve(item, ServingResult(
-                        ok=False, error=f"read: {type(exc).__name__}"))
-                    continue
-                last_id = batches[-1].events[-1].id
-                if last_id + 1 != next_id:
-                    if item.requeues < MAX_REQUEUES:
-                        self._requeue(item)
-                        continue
-                    # history and execution row still disagree after the
-                    # requeue budget (a permanent orphan tail from a
-                    # mid-commit crash): comparing torn state against
-                    # the device would count a PHANTOM divergence on the
-                    # gated counter — bypass instead, never serve
-                    self.resident.invalidate(item.key)
-                    scope.inc(m.M_SERVING_BYPASSED)
-                    self._resolve(item, ServingResult(
-                        ok=False, path="bypass", error="unstable-store"))
-                    continue
-                item.expected_row = np.asarray(row, dtype=np.int64)
-                item.expected_branch = br
-            hit = self.resident.lookup(item.key, batches)
-            if hit is None:
-                cold.append((item, batches))
-            elif hit[0] == "exact":
-                self._serve_exact(item, hit[1])
+            if self._injected_reads:
+                self._route_full_read(item, suffix, suffix_items, cold)
             else:
-                entry = hit[1]
-                rows = self.pack_cache.encode_suffix(
-                    item.key, batches, entry.address.batch_count)
-                suffix.append((item.key, entry,
-                               (rows, content_address(batches))))
-                suffix_items.append(item)
+                self._route_ranged(item, suffix, suffix_items, cold)
 
         if suffix:
             self._flush_suffix(suffix, suffix_items)
@@ -563,6 +512,190 @@ class ServingScheduler:
         dt = time.perf_counter() - t_flush
         self._flush_ewma_s = (0.7 * self._flush_ewma_s + 0.3 * dt
                               if self._flush_ewma_s else dt)
+
+    def _route_full_read(self, item: _Pending, suffix, suffix_items,
+                         cold) -> None:
+        """The full-read store arbitration (injected-seam clusters and
+        the genuine-cold fallback): read the whole history, tail-check,
+        and partition by resident relation."""
+        scope = self._scope()
+        try:
+            batches = self._read_batches(item.key)
+        except Exception as exc:
+            self._resolve(item, ServingResult(
+                ok=False, error=f"read: {type(exc).__name__}"))
+            return
+        if batches is None or not batches:
+            # multi-branch tree (NDC branch switch) or vanished run:
+            # the resident tier never serves across those — drop any
+            # pinned state and leave the device twin to the full
+            # verify path
+            self.resident.invalidate(item.key)
+            scope.inc(m.M_SERVING_BYPASSED)
+            self._resolve(item, ServingResult(ok=False, path="bypass",
+                                              error="multi-branch"))
+            return
+        if batch_crc(batches[-1]) != item.tail_crc:
+            # the store tail moved past the enqueued transaction: a
+            # newer commit landed between submit and drain. Re-read
+            # the live row; if history and execution row disagree
+            # (mid-commit window) requeue instead of comparing torn
+            # state against the device
+            if not self._restabilize(item, batches[-1].events[-1].id):
+                return
+        hit = self.resident.lookup(item.key, batches)
+        if hit is None:
+            cold.append((item, batches))
+        elif hit[0] == "exact":
+            self._serve_exact(item, hit[1])
+        else:
+            entry = hit[1]
+            rows = self.pack_cache.encode_suffix(
+                item.key, batches, entry.address.batch_count)
+            suffix.append((item.key, entry,
+                           (rows, content_address(batches))))
+            suffix_items.append(item)
+
+    def _restabilize(self, item: _Pending, last_event_id: int) -> bool:
+        """Tail-moved arbitration shared by both read paths: re-read the
+        live execution row and retarget the item at it; requeue (or
+        bypass past the budget) when history and execution row disagree
+        — a mid-commit window whose torn state must never be compared
+        against the device. True = item retargeted, keep flushing it."""
+        scope = self._scope()
+        try:
+            row, br, next_id = self._read_live_row(item.key)
+        except Exception as exc:
+            self._resolve(item, ServingResult(
+                ok=False, error=f"read: {type(exc).__name__}"))
+            return False
+        if last_event_id + 1 != next_id:
+            if item.requeues < MAX_REQUEUES:
+                self._requeue(item)
+                return False
+            # history and execution row still disagree after the
+            # requeue budget (a permanent orphan tail from a
+            # mid-commit crash): comparing torn state against
+            # the device would count a PHANTOM divergence on the
+            # gated counter — bypass instead, never serve
+            self.resident.invalidate(item.key)
+            scope.inc(m.M_SERVING_BYPASSED)
+            self._resolve(item, ServingResult(
+                ok=False, path="bypass", error="unstable-store"))
+            return False
+        item.expected_row = np.asarray(row, dtype=np.int64)
+        item.expected_branch = br
+        return True
+
+    def _route_ranged(self, item: _Pending, suffix, suffix_items,
+                      cold) -> None:
+        """The chain-break / cold-admit fallback, O(suffix): instead of
+        re-reading the full history, probe the batch COUNT, pick the
+        best persisted candidate — the resident entry, else a persisted
+        snapshot (engine/snapshot.py) — and fetch only batches from the
+        candidate's boundary on (HistoryStore.read_batches_range). The
+        boundary batch's CRC proves the candidate still prefixes the
+        stored bytes; the fetched tail proves transaction stability.
+        Only a key with NO valid candidate pays a full read."""
+        from . import snapshot as snapshot_mod
+
+        scope = self._scope()
+        hs = self.tpu.stores.history
+        key = item.key
+        try:
+            if hs.branch_count(*key) > 1 \
+                    or hs.get_current_branch(*key) != 0:
+                total = 0  # multi-branch: bypass below
+            else:
+                total = hs.batch_count(*key)
+        except Exception as exc:
+            self._resolve(item, ServingResult(
+                ok=False, error=f"read: {type(exc).__name__}"))
+            return
+        if total == 0:
+            self.resident.invalidate(key)
+            scope.inc(m.M_SERVING_BYPASSED)
+            self._resolve(item, ServingResult(ok=False, path="bypass",
+                                              error="multi-branch"))
+            return
+        entry = self.resident.entry_for(key)
+        snap = None
+        if entry is None and snapshot_mod.enabled():
+            snaps = getattr(self.tpu.stores, "snapshot", None)
+            rec = snaps.get(key) if snaps is not None else None
+            if rec is not None and 0 < rec.batch_count <= total \
+                    and snapshot_mod.validate_record(rec, self.layout,
+                                                     self.metrics):
+                snap = rec
+        addr = (entry.address if entry is not None
+                else snap.address if snap is not None else None)
+        part = None
+        if addr is not None and 0 < addr.batch_count <= total:
+            try:
+                part = hs.as_history_batches_range(
+                    *key, from_batch=addr.batch_count - 1)
+            except Exception:
+                part = None
+            if not part or batch_crc(part[0]) != addr.last_batch_crc:
+                # candidate no longer prefixes the stored bytes (tail
+                # overwrite / reset rewrite): drop it, never serve
+                if entry is not None:
+                    self.resident.invalidate(key)
+                if snap is not None:
+                    self.metrics.inc(m.SCOPE_TPU_SNAPSHOT,
+                                     m.M_SNAP_IGNORED_STALE)
+                addr, part, entry, snap = None, None, None, None
+        if addr is None:
+            self._route_full_read(item, suffix, suffix_items, cold)
+            return
+        tail_crc_now = batch_crc(part[-1])
+        if tail_crc_now != item.tail_crc:
+            if not self._restabilize(item, part[-1].events[-1].id):
+                return
+        if snap is not None:
+            # the snapshot proved valid against stored bytes: hydrate it
+            # into the resident pool + seed the pack interner now
+            if not snapshot_mod.seed_caches(snap, self.resident,
+                                            self.pack_cache, self.layout,
+                                            self.metrics):
+                self._route_full_read(item, suffix, suffix_items, cold)
+                return
+            entry = self.resident.entry_for(key)
+            if entry is None:
+                self._route_full_read(item, suffix, suffix_items, cold)
+                return
+        if addr.batch_count == total:
+            self._serve_exact(item, entry)
+            return
+        new_addr = ContentAddress(total, tail_crc_now)
+        rows = self.pack_cache.encode_append(key, addr, part[1:],
+                                             new_addr)
+        if rows is None:
+            # pack entry evicted out from under the resident state: one
+            # full pack re-anchors it, then the suffix path proceeds
+            self._route_full_read(item, suffix, suffix_items, cold)
+            return
+        suffix.append((key, entry, (rows, new_addr)))
+        suffix_items.append(item)
+
+    def _maybe_snapshot(self, keys_events) -> None:
+        """Post-flush snapshot policy hook: feed the appended-events
+        counters and write checksum-gated records for due keys
+        (engine/snapshot.Snapshotter) — serving traffic keeps the
+        durable snapshots fresh, so a later restart or chain break
+        hydrates instead of replaying. Runs AFTER every ticket in the
+        flush group resolved: a due key's write (device readback + WAL
+        append) must never sit between co-batched callers and their
+        results."""
+        from . import snapshot as snapshot_mod
+
+        if not keys_events or self._injected_reads \
+                or not snapshot_mod.enabled():
+            return
+        snapper = self.tpu.snapshotter()
+        for key, appended_events in keys_events:
+            snapper.note_append(key, appended_events)
+            snapper.maybe_snapshot(key)
 
     def _parity(self, item: _Pending, payload: np.ndarray,
                 branch: int) -> Tuple[bool, int]:
@@ -601,7 +734,8 @@ class ServingScheduler:
             address_of=lambda token: token[1])
         scope.inc(m.M_SERVING_SUFFIX, len(items))
         scope.inc(m.M_SERVING_LAUNCHES, len(report.chunk_shapes))
-        for item, res in zip(items, results):
+        snapshot_due = []
+        for (key, _entry, token), item, res in zip(suffix, items, results):
             if not res.ok:
                 # entry already invalidated by replay_append; the oracle
                 # stays authoritative and the next transaction cold-admits
@@ -613,6 +747,9 @@ class ServingScheduler:
             self._resolve(item, ServingResult(
                 ok=parity_ok, parity_ok=parity_ok, checksum=crc,
                 path="suffix", escalated=res.escalated))
+            if parity_ok:
+                snapshot_due.append((key, int(token[0].shape[0])))
+        self._maybe_snapshot(snapshot_due)
 
     def _cold_fn(self, Wp: int, E: int):
         """Variant-cached full-replay kernel for cold admits (the
@@ -652,6 +789,7 @@ class ServingScheduler:
         from ..ops.state import CAPACITY_ERRORS
 
         scope = self._scope()
+        snapshot_due: List[Tuple[tuple, int]] = []
         groups: Dict[int, List[Tuple[_Pending, list]]] = {}
         for item, batches in cold:
             groups.setdefault(self.resident.shard_of(item.key),
@@ -710,6 +848,12 @@ class ServingScheduler:
                 self._resolve(item, ServingResult(
                     ok=parity_ok, parity_ok=parity_ok, checksum=crc,
                     path="cold"))
+                if parity_ok:
+                    # a freshly admitted cold state is the cheapest
+                    # moment to persist: no snapshot exists yet, so
+                    # the policy's first-record rule applies
+                    snapshot_due.append((item.key, 0))
+        self._maybe_snapshot(snapshot_due)
 
     def warm(self, e_shapes: Sequence[int] = (16, 32, 64, 128),
              width: Optional[int] = None) -> int:

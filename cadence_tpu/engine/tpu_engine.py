@@ -97,6 +97,10 @@ class BulkVerifyResult:
     #: keys served from the HBM-resident state cache (exact hits replay
     #: nothing; suffix hits replay only the appended batches)
     resident: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: subset of `resident` whose entry was hydrated from a PERSISTED
+    #: snapshot during this verify (engine/snapshot.py): the cold
+    #: partition became a suffix partition for these keys
+    snapshot: List[Tuple[str, str, str]] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -156,6 +160,8 @@ class TPUReplayEngine:
         #: lazy device-serving scheduler (engine/serving.py); created on
         #: first request so engines that never serve pay nothing
         self._serving = None
+        #: lazy checksum-gated snapshot writer (engine/snapshot.py)
+        self._snapshotter = None
 
     def serving_scheduler(self):
         """The micro-batching transaction scheduler bound to THIS
@@ -168,6 +174,24 @@ class TPUReplayEngine:
             from .serving import ServingScheduler
             self._serving = ServingScheduler(self)
         return self._serving
+
+    def snapshotter(self):
+        """The checksum-gated snapshot writer bound to THIS engine's
+        stores / resident pool / pack cache (engine/snapshot.Snapshotter)
+        — one per engine for the same reason the serving scheduler is:
+        writer and verify must share the resident pool."""
+        if self._snapshotter is None:
+            from .snapshot import Snapshotter
+            self._snapshotter = Snapshotter(
+                self.stores, self.resident, self.pack_cache, self.layout,
+                registry=self.metrics)
+        return self._snapshotter
+
+    def snapshot_sweep(self, keys=None, force: bool = False):
+        """Persist snapshots for every resident workflow (or `keys`):
+        the deploy/admin warm-up verb — run after a verify pass seeds
+        the pool, so the next restart is a warm start."""
+        return self.snapshotter().sweep(keys=keys, force=force)
 
     @property
     def mesh(self):
@@ -207,6 +231,8 @@ class TPUReplayEngine:
             self.resident.metrics = registry
         if getattr(self, "_serving", None) is not None:
             self._serving.metrics = registry
+        if getattr(self, "_snapshotter", None) is not None:
+            self._snapshotter.metrics = registry
 
     def _load_histories(self, keys: Sequence[Tuple[str, str, str]]):
         return [
@@ -455,11 +481,22 @@ class TPUReplayEngine:
         and cold keys for the full-replay path. Non-single-lineage keys
         (an NDC branch switch happened since the state was pinned) and
         stale addresses (tail overwrite, reset rewrite) invalidate their
-        entries here — the cache never serves across those mutations."""
+        entries here — the cache never serves across those mutations.
+
+        Persisted snapshots turn the cold partition into a SUFFIX
+        partition: a would-be-cold key with a valid snapshot hydrates
+        the durable state row into the pool (engine/snapshot.py) and
+        re-partitions as an exact/suffix hit — the warm-restart path of
+        verify_all. Hydrated keys are returned so the result can report
+        them."""
+        from . import snapshot as snapshot_mod
+
         exact: List[Tuple[Tuple[str, str, str], object]] = []
         suffix: List[Tuple[Tuple[str, str, str], object, list]] = []
         cold: List[Tuple[str, str, str]] = []
         addresses: dict = {}
+        hydrated: List[Tuple[str, str, str]] = []
+        snapshots = getattr(self.stores, "snapshot", None)
         hs = self.stores.history
         for key in keys:
             if (hs.branch_count(*key) > 1
@@ -469,6 +506,12 @@ class TPUReplayEngine:
                 continue
             batches = hs.as_history_batches(*key)
             hit = self.resident.lookup(key, batches)
+            if hit is None and snapshot_mod.seed_from_batches(
+                    snapshots, self.resident, self.pack_cache, key,
+                    batches, self.layout, self.metrics):
+                hit = self.resident.lookup(key, batches)
+                if hit is not None:
+                    hydrated.append(key)
             if hit is None:
                 addresses[key] = content_address(batches)
                 cold.append(key)
@@ -476,7 +519,7 @@ class TPUReplayEngine:
                 exact.append((key, hit[1]))
             else:
                 suffix.append((key, hit[1], batches))
-        return exact, suffix, cold, addresses
+        return exact, suffix, cold, addresses, hydrated
 
     def verify_all(self, keys: Optional[Sequence[Tuple[str, str, str]]] = None
                    ) -> BulkVerifyResult:
@@ -514,8 +557,9 @@ class TPUReplayEngine:
         self.mesh
         result = BulkVerifyResult(total=len(all_keys), verified_on_device=0)
         if resident_mod.enabled():
-            exact, suffix, keys, addresses = \
+            exact, suffix, keys, addresses, hydrated = \
                 self._partition_resident(all_keys)
+            result.snapshot = hydrated
         else:
             exact, suffix, keys, addresses = [], [], all_keys, {}
 

@@ -26,7 +26,11 @@ framework's one durable artifact. Two passes share one report:
   * ``history-size-mismatch``  — a rebuilt state whose history_size does
     not equal the serialized size of its stored current-branch batches;
   * ``dangling-current-pointer`` — a pointer whose run has no snapshot
-    after rebuild (belt and braces: recovery reconciles these away).
+    after rebuild (belt and braces: recovery reconciles these away);
+  * ``stale-snapshot``         — a persisted device-state snapshot whose
+    batch count exceeds the stored history (the engine's derived
+    invalidation makes this unreachable; its presence means doctoring);
+  * ``orphaned-snapshot``      — a snapshot for a deleted/unknown run.
 
 Findings are TYPED (code + subject + detail) and surfaced on /metrics as
 ``walcheck/finding-<code>`` counters so a scrape sees what the last fsck
@@ -47,9 +51,16 @@ from .durability import (
 )
 from .persistence import Stores
 
-#: record fields that only exist at WAL_VERSION (v2): their absence under
-#: a v2 label is the stale-migration signature, per record type
-_V2_REQUIRED = {"d": ("st", "desc", "arc")}
+#: record fields that only exist from a given schema version on: their
+#: absence under a label at/past that version is the stale-migration
+#: signature, per record type — {type: (since_version, fields)}
+_REQUIRED_SINCE = {
+    "d": (2, ("st", "desc", "arc")),
+    # v3 snapshot records: a body missing its address/blob fields under
+    # a v3 label is doctoring, not a format the engine ever wrote
+    "snap": (3, ("n", "crc", "ev", "hs", "b", "pay", "blob", "bc", "im",
+                 "lay", "sv")),
+}
 
 
 @dataclass
@@ -117,14 +128,17 @@ def audit_records(raw_lines: List[str]) -> List[Finding]:
                     f"header v{version} is newer than binary v{WAL_VERSION}"))
             effective = version
             continue
-        if effective >= WAL_VERSION and t in _V2_REQUIRED:
-            missing = [k for k in _V2_REQUIRED[t] if k not in rec]
-            if missing:
-                findings.append(Finding(
-                    "stale-migration-label", f"line {i + 1}",
-                    f"record type {t!r} labeled v{effective} but missing "
-                    f"v{WAL_VERSION} fields {missing} — an unmigrated "
-                    "prefix under a current-version header"))
+        if t in _REQUIRED_SINCE:
+            since, required = _REQUIRED_SINCE[t]
+            if effective >= since:
+                missing = [k for k in required if k not in rec]
+                if missing:
+                    findings.append(Finding(
+                        "stale-migration-label", f"line {i + 1}",
+                        f"record type {t!r} labeled v{effective} but "
+                        f"missing v{since}+ fields {missing} — an "
+                        "unmigrated prefix under a current-version "
+                        "header"))
         if t == "h":
             runs_with_history.add((rec.get("d"), rec.get("w"), rec.get("r")))
         elif t == "delw":
@@ -189,6 +203,28 @@ def audit_stores(stores: Stores) -> List[Finding]:
                 "dangling-current-pointer",
                 f"{domain_id}/{workflow_id}/{cur.run_id}",
                 "current pointer survived recovery with no rebuilt state"))
+
+    # persisted device-state snapshots vs the rebuilt history: the
+    # engine's derived invalidation (tail overwrite, branch switch,
+    # deletion replayed in order) makes both classes unreachable from
+    # normal operation — their presence means doctored or lost records
+    snaps = getattr(stores, "snapshot", None)
+    if snaps is not None:
+        known_runs = set(stores.history.list_runs())
+        for key, rec in snaps.items():
+            if key not in known_runs:
+                findings.append(Finding(
+                    "orphaned-snapshot", "/".join(key),
+                    "snapshot for a deleted/unknown run — no stored "
+                    "history to anchor its content address"))
+                continue
+            stored = stores.history.batch_count(*key)
+            if rec.batch_count > stored:
+                findings.append(Finding(
+                    "stale-snapshot", "/".join(key),
+                    f"snapshot covers {rec.batch_count} batches but the "
+                    f"store holds only {stored} — the snapshot leads "
+                    "its own history"))
     return findings
 
 

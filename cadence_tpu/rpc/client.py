@@ -43,7 +43,7 @@ from .wire import Connection, WireError
 
 #: every sub-store a Stores bundle exposes (persistence.Stores fields)
 SUBSTORES = ("shard", "history", "task", "domain", "visibility", "queue",
-             "shard_tasks", "execution")
+             "shard_tasks", "execution", "snapshot")
 
 #: metrics scope for the client resilience tier
 SCOPE_RPC_CLIENT = "rpc.client"

@@ -278,6 +278,15 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             self.metrics.inc(cm.SCOPE_TPU_SERVING, metric, 0)
         self.metrics.gauge(cm.SCOPE_TPU_SERVING, cm.M_SERVING_QUEUE_DEPTH,
                            0.0)
+        # snapshot-tier series pre-registered (tpu.snapshot/*): a scrape
+        # must distinguish "no torn snapshots" from "series missing",
+        # same contract as the serving divergence counter
+        for metric in (cm.M_SNAP_WRITES, cm.M_SNAP_CHECKSUM_SKIPS,
+                       cm.M_SNAP_HYDRATES, cm.M_SNAP_IGNORED_STALE,
+                       cm.M_SNAP_IGNORED_TORN):
+            self.metrics.inc(cm.SCOPE_TPU_SNAPSHOT, metric, 0)
+        for gauge in (cm.M_SNAP_ENTRIES, cm.M_SNAP_BYTES):
+            self.metrics.gauge(cm.SCOPE_TPU_SNAPSHOT, gauge, 0.0)
         # the tier itself (engine/serving.py): CADENCE_TPU_SERVING=1
         # builds this host's TPUReplayEngine over the REMOTE stores and
         # hands every engine a shared scheduler — committed transactions

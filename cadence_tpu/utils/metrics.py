@@ -57,6 +57,8 @@ SCOPE_TPU_NATIVE = "tpu.native"
 #: from-state launch per owning mesh device; counters below under
 #: M_SERVING_*
 SCOPE_TPU_SERVING = "tpu.serving"
+#: M_SNAP_* (engine/snapshot.py — the persisted mutable-state tier)
+SCOPE_TPU_SNAPSHOT = "tpu.snapshot"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -190,6 +192,21 @@ M_SERVING_BYPASSED = "bypassed"
 M_SERVING_REQUEUED = "requeued"
 M_SERVING_REJECTED = "busy-rejections"
 M_SERVING_QUEUE_DEPTH = "queue-depth"
+#: persisted mutable-state snapshot tier (engine/snapshot.py,
+#: SCOPE_TPU_SNAPSHOT): `writes` counts checksum-gated snapshot records
+#: appended to the WAL, `checksum-skips` counts writes refused because
+#: the resident payload disagreed with the oracle's live state (never
+#: persisted), `hydrates` counts snapshot→resident seeds on a cold path
+#: (restart, chain break, cold admit), `ignored-stale`/`ignored-torn`
+#: count snapshots detected invalid and skipped — fallen back to full
+#: replay, never served; the gauges mirror the store's occupancy
+M_SNAP_WRITES = "writes"
+M_SNAP_CHECKSUM_SKIPS = "checksum-skips"
+M_SNAP_HYDRATES = "hydrates"
+M_SNAP_IGNORED_STALE = "ignored-stale"
+M_SNAP_IGNORED_TORN = "ignored-torn"
+M_SNAP_BYTES = "snapshot-bytes"
+M_SNAP_ENTRIES = "snapshot-entries"
 
 
 def ladder_rung_rows(rung: int) -> str:

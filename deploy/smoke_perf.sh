@@ -16,6 +16,12 @@
 #     resident-state cache cost O(new events) — long-history appends
 #     within 1.5x of short-history appends at equal suffix size
 #     (detail.incremental in the recorded JSON);
+#   - the SNAPSHOT gate holds (TestSnapshotGate, ISSUE 11): restarting
+#     with persisted mutable-state snapshots rebuilds warm — hydrate +
+#     replay only the since-snapshot suffix — in <= 0.3x the cold
+#     full-replay time on a long-history corpus, with zero cold-vs-warm
+#     state divergence and every workflow hydrated from its record
+#     (detail.snapshot in the recorded JSON);
 #   - the MESH gate holds (TestMeshGate): the serving executor on a mesh
 #     of 1 stays byte-identical to the unsharded kernel, warm passes
 #     recompile nothing across mesh shapes already seen, mesh-of-N
@@ -68,6 +74,8 @@ env BENCH_NS_WORKFLOWS="${BENCH_NS_WORKFLOWS:-16384}" \
     BENCH_INCR_WORKFLOWS="${BENCH_INCR_WORKFLOWS:-512}" \
     BENCH_INCR_SHORT="${BENCH_INCR_SHORT:-32}" \
     BENCH_INCR_LONG="${BENCH_INCR_LONG:-256}" \
+    BENCH_SNAP_WORKFLOWS="${BENCH_SNAP_WORKFLOWS:-256}" \
+    BENCH_SNAP_EVENTS="${BENCH_SNAP_EVENTS:-384}" \
     python bench.py > "$OUT"
 
 # mesh gate, on a virtual-device CPU mesh (the dryrun_multichip

@@ -314,8 +314,9 @@ class TestFsck:
         rc = cli_main(["--wal", wal, "wal", "clean"])
         capsys.readouterr()
         assert rc == 0
+        from cadence_tpu.engine.durability import WAL_VERSION
         records = read_log(wal)
-        assert records[0] == {"t": "ver", "v": 2}
+        assert records[0] == {"t": "ver", "v": WAL_VERSION}
         domain_rec = records[1]
         assert {"st", "desc", "arc"} <= set(domain_rec)  # migrated body
         report = walcheck.fsck(wal)

@@ -196,6 +196,47 @@ class TestIncrementalGate:
             f"1.5x of short {p50_s}ms")
 
 
+class TestSnapshotGate:
+    """The warm-restart gate (ISSUE 11): restarting with persisted
+    mutable-state snapshots must hydrate + replay only the
+    since-snapshot suffixes — warm rebuild time <= 0.3x cold full-replay
+    time on a long-history corpus, with zero oracle<->device divergence
+    and every workflow genuinely hydrated from its snapshot."""
+
+    def test_warm_restart_within_budget_in_process(self):
+        import bench
+        from cadence_tpu.core.checksum import DEFAULT_LAYOUT
+
+        res = bench._snapshot_suite(DEFAULT_LAYOUT, workflows=64,
+                                    target_events=384, trials=3)
+        assert res["divergent"] == 0
+        assert res["hydrated"] == res["workflows"], res
+        assert res["snapshot_records"] == res["workflows"]
+        # the suffix is a fraction of the history: replayed events on
+        # the warm path must be far below the corpus total
+        assert res["suffix_events_replayed"] \
+            <= res["workflows"] * res["history_events_mean"] * 0.5
+        # warm <= 0.3x cold (+25ms absolute slack for shared-box noise;
+        # the replayed work differs by an order of magnitude)
+        assert res["warm_restart_s"] \
+            <= 0.3 * res["cold_restart_s"] + 0.025, (
+                f"warm restart {res['warm_restart_s']}s vs cold "
+                f"{res['cold_restart_s']}s — the snapshot tier is not "
+                f"buying the suffix-only restart")
+
+    def test_snapshot_recorded_in_bench_json(self):
+        """smoke_perf.sh's recorded run must carry the snapshot suite
+        and hold the same contract (hardware-pinned CI)."""
+        cur = _load_bench("PERF_CURRENT")["detail"].get("snapshot")
+        assert cur, "current bench carries no snapshot suite"
+        assert cur["divergent"] == 0
+        assert cur["hydrated"] == cur["workflows"]
+        assert cur["warm_restart_s"] \
+            <= 0.3 * cur["cold_restart_s"] + 0.025, (
+                f"recorded warm restart {cur['warm_restart_s']}s "
+                f"regressed past 0.3x of cold {cur['cold_restart_s']}s")
+
+
 class TestMeshGate:
     """The mesh-aware serving executor gate (ISSUE 7): mesh-of-1 must be
     byte-identical to the unsharded kernel (the pre-mesh single-chip

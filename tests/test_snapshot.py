@@ -1,0 +1,629 @@
+"""Persisted mutable-state snapshots (ISSUE 11).
+
+Covers: checksum-gated snapshot writes (a diverged resident payload is
+never persisted); warm vs cold restart byte-parity across the workload
+suites on both WAL backends; stale/torn/foreign snapshots detected and
+ignored with full-replay fallback; derived invalidation on tail
+overwrite / NDC branch switch / run deletion; the batch-range history
+read's parity with the full read; the serving chain-break fallback
+hydrating from a snapshot WITHOUT reading the full history (the
+raising-prefix-read seam); the wal fsck stale-/orphaned-snapshot
+findings; and the crashsim cut-point sweep over snapshot records.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    Checksum,
+    payload_row,
+)
+from cadence_tpu.engine.cache import batch_crc, content_address
+from cadence_tpu.engine.persistence import Stores
+from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.utils import metrics as m
+
+SUITES = ("basic", "timer_retry", "concurrent_child", "ndc")
+
+
+def _seed_stores(stores, suite="basic", n=3, target_events=24, seed=7):
+    """Append generated histories + oracle-rebuilt mutable states (the
+    store shape verify_all expects); returns the run keys."""
+    hists = generate_corpus(suite, num_workflows=n, seed=seed,
+                            target_events=target_events)
+    keys = []
+    for h in hists:
+        b0 = h[0]
+        key = (b0.domain_id, b0.workflow_id, b0.run_id)
+        for b in h:
+            stores.history.append_batch(*key, list(b.events))
+        ms = StateBuilder().replay_history(
+            stores.history.as_history_batches(*key))
+        info = ms.execution_info
+        info.domain_id, info.workflow_id, info.run_id = key
+        stores.execution.upsert_workflow(ms)
+        keys.append(key)
+    return keys
+
+
+def _oracle_row(batches, layout=DEFAULT_LAYOUT):
+    row = payload_row(StateBuilder().replay_history(batches), layout)
+    row[STICKY_ROW_INDEX] = 0
+    return row
+
+
+# ---------------------------------------------------------------------------
+# store mechanics: batch-range reads + derived invalidation
+# ---------------------------------------------------------------------------
+
+
+class TestBatchRangeRead:
+    def test_range_read_parity_with_full_read(self):
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=30)
+        full = stores.history.read_batches(*key)
+        total = stores.history.batch_count(*key)
+        assert total == len(full) > 3
+        for c in (0, 1, total // 2, total - 1, total):
+            part = stores.history.read_batches_range(*key, from_batch=c)
+            assert part == full[c:]
+        hb = stores.history.as_history_batches_range(*key,
+                                                     from_batch=total - 1)
+        assert len(hb) == 1 and hb[0].events == full[-1]
+        assert stores.history.batch_count("x", "y", "z") == 0
+
+    def test_snapshot_survives_overwrite_beyond_its_point(self):
+        """A tail overwrite strictly past the snapshot point keeps the
+        snapshot (still a valid prefix); one at/before it drops it."""
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=30)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        full = stores.history.read_batches(*key)
+        # snapshot everything (force bypasses the policy gates)
+        assert tpu.snapshot_sweep(force=True).written == 1
+        snap = stores.snapshot.get(key)
+        assert snap is not None and snap.batch_count == len(full)
+        # rewrite ONLY the final batch: overwrite lands at index n-1,
+        # which the tip snapshot covers -> dropped
+        stores.history.append_batch(*key, list(full[-1]))
+        assert stores.snapshot.get(key) is None
+
+    def test_mid_batch_truncating_overwrite_drops_tip_snapshot(self):
+        """An overwrite landing MID-batch truncates the last kept batch
+        — its bytes change, so a snapshot covering it must drop (the
+        boundary is one batch earlier than a clean-boundary rewrite)."""
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=30)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        full = stores.history.read_batches(*key)
+        last = full[-1]
+        if len(last) < 2:
+            pytest.skip("corpus tail batch too short to split")
+        # rewrite from the SECOND event of the final batch: the kept
+        # half of that batch is itself rewritten bytes
+        stores.history.append_batch(*key, list(last[1:]))
+        assert stores.snapshot.get(key) is None
+
+    def test_snapshot_dropped_on_branch_switch_and_delete(self):
+        stores = Stores()
+        keys = _seed_stores(stores, n=2, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 2
+        # NDC branch switch
+        k0 = keys[0]
+        stores.history.fork_branch(*k0, source_branch=0, fork_event_id=2)
+        stores.history.set_current_branch(*k0, branch=1)
+        assert stores.snapshot.get(k0) is None
+        # run deletion
+        k1 = keys[1]
+        stores.history.delete_run(*k1)
+        assert stores.snapshot.get(k1) is None
+
+    def test_prefix_snapshot_survives_pure_append(self):
+        """Appending new batches never invalidates (the whole point:
+        the snapshot remains a valid prefix the suffix replays from)."""
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=30)
+        full = stores.history.read_batches(*key)
+        # rebuild the store holding only the prefix, snapshot it there
+        pre = Stores()
+        for b in full[:-1]:
+            pre.history.append_batch(*key, list(b))
+        ms = StateBuilder().replay_history(
+            pre.history.as_history_batches(*key))
+        info = ms.execution_info
+        info.domain_id, info.workflow_id, info.run_id = key
+        pre.execution.upsert_workflow(ms)
+        tpu = TPUReplayEngine(pre)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        pre.history.append_batch(*key, list(full[-1]))
+        snap = pre.snapshot.get(key)
+        assert snap is not None and snap.batch_count == len(full) - 1
+
+
+# ---------------------------------------------------------------------------
+# the checksum gate: a diverged payload is never persisted
+# ---------------------------------------------------------------------------
+
+
+class TestChecksumGate:
+    def test_diverged_resident_payload_refused(self):
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        # corrupt the LIVE oracle state after the resident pin: the
+        # write gate compares resident payload vs oracle row and must
+        # refuse (a snapshot of either side would persist a lie)
+        ms = stores.execution.get_workflow(*key)
+        ms.execution_info.signal_count += 1
+        reg = tpu.metrics
+        pre = reg.counter(m.SCOPE_TPU_SNAPSHOT, m.M_SNAP_CHECKSUM_SKIPS)
+        sweep = tpu.snapshot_sweep(force=True)
+        assert sweep.written == 0
+        assert sweep.skipped_checksum == 1
+        assert reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                           m.M_SNAP_CHECKSUM_SKIPS) == pre + 1
+        assert len(stores.snapshot) == 0
+
+    def test_policy_gates_due_and_min_events(self, monkeypatch):
+        from cadence_tpu.engine.snapshot import Snapshotter
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        snapper = Snapshotter(stores, tpu.resident, tpu.pack_cache,
+                              tpu.layout, registry=tpu.metrics,
+                              min_events=10_000, every_events=4)
+        # min-events floor: no snapshot yet -> due, but the history is
+        # far too small for the floor
+        assert snapper.due(key)
+        assert not snapper.snapshot_key(key)
+        snapper.min_events = 1
+        assert snapper.snapshot_key(key)
+        # freshly written: not due until every_events accumulate
+        assert not snapper.due(key)
+        snapper.note_append(key, 3)
+        assert not snapper.due(key)
+        snapper.note_append(key, 1)
+        assert snapper.due(key)
+
+
+# ---------------------------------------------------------------------------
+# warm vs cold restart byte-parity, every suite, both WAL backends
+# ---------------------------------------------------------------------------
+
+
+class TestWarmRestartParity:
+    def test_warm_equals_cold_across_suites(self, wal, monkeypatch):
+        """The acceptance core: recover the same WAL twice — snapshots
+        disabled (cold: full-history replay storm) and enabled (warm:
+        hydrate + suffix) — and require byte-identical mutable states
+        for every run of every workload suite, zero divergence both
+        ways, and the warm pass actually hydrating."""
+        from cadence_tpu.engine import snapshot as snapshot_mod
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            recover_stores,
+        )
+
+        stores = open_durable_stores(wal)
+        keys = []
+        for i, suite in enumerate(SUITES):
+            keys += _seed_stores(stores, suite=suite, n=2,
+                                 target_events=20, seed=20 + i)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        sweep = tpu.snapshot_sweep(force=True)
+        assert sweep.written == len(keys)
+        stores.wal.close()
+
+        monkeypatch.setenv(snapshot_mod.ENABLE_ENV, "0")
+        cold, rep_cold = recover_stores(wal, verify_on_device=True,
+                                        rebuild_on_device=True)
+        assert rep_cold.ok and rep_cold.snapshot_hydrated == 0
+        cold.wal.close()
+
+        monkeypatch.setenv(snapshot_mod.ENABLE_ENV, "1")
+        warm, rep_warm = recover_stores(wal, verify_on_device=True,
+                                        rebuild_on_device=True)
+        assert rep_warm.ok
+        assert rep_warm.snapshot_hydrated == len(keys)
+        for key in keys:
+            assert Checksum.of(cold.execution.get_workflow(*key)).value \
+                == Checksum.of(warm.execution.get_workflow(*key)).value
+        warm.wal.close()
+
+    def test_warm_restart_after_post_snapshot_appends(self, tmp_path):
+        """Snapshots taken mid-history: appends land after the sweep, so
+        recovery must hydrate + replay ONLY the suffix and still land on
+        the oracle's bytes."""
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            recover_stores,
+        )
+
+        wal = str(tmp_path / "midsnap.jsonl")
+        stores = open_durable_stores(wal)
+        hists = generate_corpus("basic", num_workflows=3, seed=31,
+                                target_events=28)
+        keys = []
+        for h in hists:
+            b0 = h[0]
+            key = (b0.domain_id, b0.workflow_id, b0.run_id)
+            for b in h[:-2]:
+                stores.history.append_batch(*key, list(b.events))
+            ms = StateBuilder().replay_history(
+                stores.history.as_history_batches(*key))
+            info = ms.execution_info
+            info.domain_id, info.workflow_id, info.run_id = key
+            stores.execution.upsert_workflow(ms)
+            keys.append(key)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == len(keys)
+        # two more batches commit AFTER the snapshot
+        for h, key in zip(hists, keys):
+            for b in h[-2:]:
+                stores.history.append_batch(*key, list(b.events))
+            ms = StateBuilder().replay_history(
+                stores.history.as_history_batches(*key))
+            info = ms.execution_info
+            info.domain_id, info.workflow_id, info.run_id = key
+            stores.execution.upsert_workflow(ms)
+        stores.wal.close()
+
+        warm, rep = recover_stores(wal, verify_on_device=True,
+                                   rebuild_on_device=True)
+        assert rep.ok and rep.snapshot_hydrated == len(keys)
+        for h, key in zip(hists, keys):
+            expected = StateBuilder().replay_history(
+                warm.history.as_history_batches(*key))
+            assert Checksum.of(warm.execution.get_workflow(*key)).value \
+                == Checksum.of(expected).value
+        warm.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# stale / torn snapshots: detected, counted, ignored — never served
+# ---------------------------------------------------------------------------
+
+
+class TestTornAndStaleRejection:
+    def _engine_with_snapshot(self):
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        tpu.resident.clear()
+        tpu.pack_cache.clear()
+        return stores, tpu, key
+
+    def test_torn_blob_falls_back_to_full_replay(self):
+        stores, tpu, key = self._engine_with_snapshot()
+        rec = stores.snapshot.get(key)
+        rec.state_blob = rec.state_blob[:-7] + b"\x7f" * 7  # torn bytes
+        reg = tpu.metrics
+        result = tpu.verify_all()
+        assert result.ok and not result.snapshot
+        assert reg.counter(m.SCOPE_TPU_SNAPSHOT, m.M_SNAP_IGNORED_TORN) >= 1
+        assert reg.counter(m.SCOPE_TPU_SNAPSHOT, m.M_SNAP_HYDRATES) == 0
+
+    def test_stale_address_falls_back_to_full_replay(self):
+        stores, tpu, key = self._engine_with_snapshot()
+        rec = stores.snapshot.get(key)
+        rec.last_batch_crc ^= 0xDEAD  # bytes under the address changed
+        reg = tpu.metrics
+        result = tpu.verify_all()
+        assert result.ok and not result.snapshot
+        assert reg.counter(m.SCOPE_TPU_SNAPSHOT,
+                           m.M_SNAP_IGNORED_STALE) >= 1
+
+    def test_foreign_layout_falls_back_to_full_replay(self):
+        stores, tpu, key = self._engine_with_snapshot()
+        rec = stores.snapshot.get(key)
+        rec.layout = tuple(v * 2 for v in rec.layout)
+        result = tpu.verify_all()
+        assert result.ok and not result.snapshot
+        assert tpu.metrics.counter(m.SCOPE_TPU_SNAPSHOT,
+                                   m.M_SNAP_IGNORED_STALE) >= 1
+
+    def test_kill_switch_disables_hydration(self, monkeypatch):
+        from cadence_tpu.engine import snapshot as snapshot_mod
+        stores, tpu, key = self._engine_with_snapshot()
+        monkeypatch.setenv(snapshot_mod.ENABLE_ENV, "0")
+        result = tpu.verify_all()
+        assert result.ok and not result.snapshot
+        assert tpu.metrics.counter(m.SCOPE_TPU_SNAPSHOT,
+                                   m.M_SNAP_HYDRATES) == 0
+
+
+# ---------------------------------------------------------------------------
+# wal fsck: the two new typed findings
+# ---------------------------------------------------------------------------
+
+
+class TestFsckFindings:
+    def _doctored_wal(self, wal, doctor):
+        from cadence_tpu.engine.durability import (
+            open_durable_stores,
+            read_log,
+        )
+        stores = open_durable_stores(wal)
+        (key,) = _seed_stores(stores, n=1, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        stores.wal.close()
+        # doctor the snap record in place (both backends)
+        from cadence_tpu.engine.durability import (
+            SqliteLog,
+            is_sqlite_path,
+        )
+        records = read_log(wal)
+        for rec in records:
+            if rec.get("t") == "snap":
+                doctor(rec)
+        if is_sqlite_path(wal):
+            SqliteLog.rewrite(wal, records)
+        else:
+            with open(wal, "w") as f:
+                for rec in records:
+                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        return key
+
+    def test_stale_snapshot_finding(self, wal):
+        from cadence_tpu.engine.walcheck import fsck
+        from cadence_tpu.utils.metrics import DEFAULT_REGISTRY
+
+        self._doctored_wal(wal, lambda rec: rec.update(n=rec["n"] + 5))
+        report = fsck(wal)
+        assert [f.code for f in report.findings] == ["stale-snapshot"]
+        assert DEFAULT_REGISTRY.counter(
+            "walcheck", "finding-stale-snapshot") == 1
+
+    def test_orphaned_snapshot_finding(self, wal):
+        from cadence_tpu.engine.walcheck import fsck
+        from cadence_tpu.utils.metrics import DEFAULT_REGISTRY
+
+        self._doctored_wal(wal, lambda rec: rec.update(w="no-such-wf"))
+        report = fsck(wal)
+        assert [f.code for f in report.findings] == ["orphaned-snapshot"]
+        assert DEFAULT_REGISTRY.counter(
+            "walcheck", "finding-orphaned-snapshot") == 1
+
+    def test_clean_wal_has_no_snapshot_findings(self, wal):
+        from cadence_tpu.engine.durability import open_durable_stores
+        from cadence_tpu.engine.walcheck import fsck
+
+        stores = open_durable_stores(wal)
+        _seed_stores(stores, n=2, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 2
+        stores.wal.close()
+        assert fsck(wal).ok
+
+
+# ---------------------------------------------------------------------------
+# crashsim: the cut-point matrix sweeps snapshot records too
+# ---------------------------------------------------------------------------
+
+
+class TestCrashsimOverSnapshots:
+    def test_cut_matrix_with_snapshot_records(self, wal):
+        """Kill-anywhere over a WAL that interleaves history, snapshot,
+        and post-snapshot history records: every prefix (and torn tail,
+        on JSONL) must recover to a legal state with zero fsck findings
+        — a half-written snapshot can cost a warm start, never
+        correctness."""
+        from cadence_tpu.engine.crashsim import CrashSim, seed_workload
+        from cadence_tpu.engine.durability import recover_stores
+        from cadence_tpu.engine.walcheck import read_raw_lines
+
+        seed_workload(wal, num_workflows=2)
+        stores, _ = recover_stores(wal, verify_on_device=False,
+                                   rebuild_on_device=True)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written >= 1
+        # one more committed batch AFTER the snapshots, so cuts land on
+        # snapshot-then-history interleavings too
+        key = tpu.snapshotter().stores.snapshot.keys()[0]
+        batches = stores.history.read_batches(*key)
+        stores.history.append_batch(*key, list(batches[-1]))
+        stores.wal.close()
+
+        raw = read_raw_lines(wal)
+        assert any('"snap"' in l or "'snap'" in l or '"t": "snap"' in l
+                   or '"t":"snap"' in l for l in raw), \
+            "workload WAL carries no snapshot records to cut through"
+        report = CrashSim(wal).run(torn=True, stride=5)
+        assert report.ok, report.summary()
+
+
+# ---------------------------------------------------------------------------
+# serving chain-break fallback: snapshot hydrate + batch-range read ONLY
+# ---------------------------------------------------------------------------
+
+
+class TestServingChainBreakRanged:
+    def test_chain_break_never_reads_full_history(self, monkeypatch):
+        """The acceptance seam: after a restart (resident + pack caches
+        empty, snapshot persisted), a committed transaction whose chain
+        is broken must serve through snapshot hydrate + batch-range read
+        — with the FULL-history read path booby-trapped to raise."""
+        stores = Stores()
+        hists = generate_corpus("basic", num_workflows=1, seed=13,
+                                target_events=28)
+        h = hists[0]
+        b0 = h[0]
+        key = (b0.domain_id, b0.workflow_id, b0.run_id)
+        for b in h[:-1]:
+            stores.history.append_batch(*key, list(b.events))
+        ms = StateBuilder().replay_history(
+            stores.history.as_history_batches(*key))
+        info = ms.execution_info
+        info.domain_id, info.workflow_id, info.run_id = key
+        stores.execution.upsert_workflow(ms)
+
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        # restart: HBM and host caches are gone; the snapshot is not
+        tpu.resident.clear()
+        tpu.pack_cache.clear()
+
+        # commit one more batch + the oracle's post-state
+        stores.history.append_batch(*key, list(h[-1].events))
+        full = stores.history.as_history_batches(*key)
+        ms2 = StateBuilder().replay_history(full)
+        info2 = ms2.execution_info
+        info2.domain_id, info2.workflow_id, info2.run_id = key
+        stores.execution.upsert_workflow(ms2)
+        expected = _oracle_row(full)
+        tail_crc = batch_crc(full[-1])
+
+        # booby-trap every prefix-reading seam
+        def boom(*a, **k):
+            raise AssertionError("full-history read on the chain-break "
+                                 "fallback path")
+        monkeypatch.setattr(stores.history, "read_batches", boom)
+
+        sched = tpu.serving_scheduler()
+        try:
+            ticket = sched.submit(
+                key, expected,
+                int(ms2.version_histories.current_index), tail_crc)
+            res = ticket.result(timeout=120.0)
+        finally:
+            sched.stop()
+        assert res.ok and res.parity_ok, res
+        assert res.path == "suffix"
+        assert tpu.metrics.counter(m.SCOPE_TPU_SNAPSHOT,
+                                   m.M_SNAP_HYDRATES) == 1
+
+    def test_exact_chain_break_served_from_snapshot(self, monkeypatch):
+        """Tip snapshot + chain break: zero device work, zero prefix
+        reads — the persisted payload answers the parity check."""
+        stores = Stores()
+        (key,) = _seed_stores(stores, n=1, target_events=24)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 1
+        tpu.resident.clear()
+        tpu.pack_cache.clear()
+
+        full = stores.history.as_history_batches(*key)
+        ms = stores.execution.get_workflow(*key)
+        expected = payload_row(ms, tpu.layout)
+        expected[STICKY_ROW_INDEX] = 0
+
+        def boom(*a, **k):
+            raise AssertionError("full-history read on the exact path")
+        monkeypatch.setattr(stores.history, "read_batches", boom)
+
+        sched = tpu.serving_scheduler()
+        try:
+            ticket = sched.submit(
+                key, expected, int(ms.version_histories.current_index),
+                batch_crc(full[-1]))
+            res = ticket.result(timeout=120.0)
+        finally:
+            sched.stop()
+        assert res.ok and res.path == "exact", res
+
+
+# ---------------------------------------------------------------------------
+# rebuild: reset-prefix path stops re-encoding the prefix (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestRebuildSuffixOnly:
+    def test_snapshotted_rebuild_never_packs_the_prefix(self):
+        """A standalone DeviceRebuilder (the reset/recovery shape) with
+        a snapshot wired must hydrate + suffix-encode through its OWN
+        pack cache: zero full-pack misses — the prefix is never
+        re-encoded on the host."""
+        from cadence_tpu.engine.rebuild import DeviceRebuilder
+
+        stores = Stores()
+        hists = generate_corpus("basic", num_workflows=2, seed=17,
+                                target_events=26)
+        keys = []
+        for h in hists:
+            b0 = h[0]
+            key = (b0.domain_id, b0.workflow_id, b0.run_id)
+            for b in h[:-1]:
+                stores.history.append_batch(*key, list(b.events))
+            ms = StateBuilder().replay_history(
+                stores.history.as_history_batches(*key))
+            info = ms.execution_info
+            info.domain_id, info.workflow_id, info.run_id = key
+            stores.execution.upsert_workflow(ms)
+            keys.append(key)
+        tpu = TPUReplayEngine(stores)
+        assert tpu.verify_all().ok
+        assert tpu.snapshot_sweep(force=True).written == 2
+        for h, key in zip(hists, keys):
+            stores.history.append_batch(*key, list(h[-1].events))
+
+        rebuilder = DeviceRebuilder(tpu.layout)
+        assert rebuilder.pack_cache is not None  # owned by default now
+        rebuilder.snapshots = stores.snapshot
+        reg = rebuilder.metrics
+        pre_miss = reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_MISSES)
+        jobs = [(stores.history.as_history_batches(*key), None)
+                for key in keys]
+        states = rebuilder.rebuild(jobs)
+        assert rebuilder.stats.snapshot_seeded == 2
+        assert rebuilder.stats.resident == 2
+        assert reg.counter(m.SCOPE_PACK_CACHE, m.M_CACHE_MISSES) \
+            == pre_miss, "a snapshotted rebuild paid a full pack"
+        for key, ms in zip(keys, states):
+            expected = StateBuilder().replay_history(
+                stores.history.as_history_batches(*key))
+            assert Checksum.of(ms).value == Checksum.of(expected).value
+
+
+# ---------------------------------------------------------------------------
+# admin surface
+# ---------------------------------------------------------------------------
+
+
+class TestAdminSnapshot:
+    def test_admin_snapshot_cli_sweep_and_rollup(self, tmp_path, capsys):
+        from cadence_tpu.cli import main as cli_main
+
+        wal = str(tmp_path / "snapcli.jsonl")
+
+        def run(*argv):
+            rc = cli_main(list(argv))
+            return rc, json.loads(capsys.readouterr().out)
+
+        rc, _ = run("--wal", wal, "domain", "register", "--name", "sd")
+        assert rc == 0
+        rc, _ = run("--wal", wal, "workflow", "start", "--domain", "sd",
+                    "--workflow-id", "w1", "--type", "t",
+                    "--task-list", "tl")
+        assert rc == 0
+        rc, out = run("--wal", wal, "admin", "snapshot", "--sweep")
+        assert rc == 0
+        assert out["sweep"]["written"] >= 1
+        assert out["entries"] >= 1 and out["bytes"] > 0
+        assert out["writes"] >= 1
+        assert "staleness_batches" in out
+        # rollup-only invocation over the recovered WAL sees the records
+        rc, out = run("--wal", wal, "admin", "snapshot")
+        assert rc == 0 and out["entries"] >= 1
