@@ -701,6 +701,145 @@ def _snapshot_suite(layout, workflows: int = 0, target_events: int = 0,
     }
 
 
+def _visibility_suite(sizes=None, trials: int = 0):
+    """Device-visibility scan rates (ISSUE 12): a synthetic visibility
+    population at each BENCH_VIS_SIZES row count, the same selectivity-
+    sweep query corpus timed through the HOST store (dict/set indexes +
+    per-record predicate) and through the COLUMNAR DEVICE tier
+    (ops/scan.py mask kernels, parity off inside the timed region so
+    the measurement is the pure device path). Count queries carry the
+    rows/s-scanned headline (scalar readback — the HBM-bandwidth
+    claim); a selective List is timed separately since it pays host
+    materialization of matches. Warm recompiles across the timed
+    repeats must be ZERO (the kernel-variant cache counters prove it —
+    the acceptance bar TestVisibilityGate pins)."""
+    from cadence_tpu.engine.persistence import (
+        VisibilityRecord,
+        VisibilityStore,
+    )
+    from cadence_tpu.utils import metrics as cm
+
+    sizes = sizes or [int(s) for s in os.environ.get(
+        "BENCH_VIS_SIZES", "10000,100000").split(",") if s]
+    trials = trials or int(os.environ.get("BENCH_VIS_TRIALS", "5"))
+    reg = cm.DEFAULT_REGISTRY
+    sc = cm.SCOPE_TPU_VISIBILITY
+    saved = {k: os.environ.get(k) for k in
+             ("CADENCE_TPU_VISIBILITY", "CADENCE_TPU_VISIBILITY_PARITY",
+              "CADENCE_TPU_VISIBILITY_CAPACITY")}
+    out_sizes = []
+    try:
+        for n in sizes:
+            os.environ["CADENCE_TPU_VISIBILITY"] = "0"
+            os.environ["CADENCE_TPU_VISIBILITY_CAPACITY"] = str(n)
+            import random
+            rng = random.Random(20260804)
+            store = VisibilityStore()
+            base = 1_700_000_000_000_000_000
+            for i in range(n):
+                attrs = {}
+                r = rng.random()
+                if r < 0.5:
+                    attrs["Priority"] = rng.randrange(0, 10)
+                elif r < 0.8:
+                    attrs["Tag"] = f"tag-{rng.randrange(4)}"
+                rec = VisibilityRecord(
+                    domain_id="bench", workflow_id=f"wf-{i}",
+                    run_id=f"r-{i}", workflow_type=f"wt-{i % 8}",
+                    start_time=base + i * 1000, search_attrs=attrs)
+                store.record_started(rec)
+                if rng.random() < 0.5:
+                    store.record_closed("bench", f"wf-{i}", f"r-{i}",
+                                        close_time=base + i * 1000 + 7,
+                                        close_status=rng.randrange(0, 3))
+            # the selectivity sweep: match fractions from ~0.01% to 100%
+            cut99 = base + int(n * 0.999) * 1000
+            queries = [
+                ("all", ""),
+                ("half_open", "CloseStatus = -1"),
+                ("type_eighth", "WorkflowType = 'wt-3'"),
+                ("attr_tenth", "Priority >= 9"),
+                ("narrow_and", "WorkflowType = 'wt-1' AND "
+                               "CloseStatus = 0 AND Priority < 2"),
+                ("time_tail", f"StartTime > {cut99}"),
+            ]
+
+            def run_counts(label):
+                t0 = time.perf_counter()
+                for _ in range(trials):
+                    for _name, q in queries:
+                        store.count("bench", q)
+                return time.perf_counter() - t0
+
+            host_s = run_counts("host")
+            sel = {name: store.count("bench", q) for name, q in queries}
+
+            os.environ["CADENCE_TPU_VISIBILITY"] = "1"
+            os.environ["CADENCE_TPU_VISIBILITY_PARITY"] = "0"
+            # warm pass: bootstrap flush + one compile per query shape
+            for _name, q in queries:
+                store.count("bench", q)
+                store.query("bench", q)
+            pre_miss = reg.counter(sc, cm.M_LADDER_CACHE_MISSES)
+            dev_s = run_counts("device")
+            warm_recompiles = (reg.counter(sc, cm.M_LADDER_CACHE_MISSES)
+                               - pre_miss)
+            # a selective list (materializes matches on the host);
+            # warm its shape first — the timed repeats must measure the
+            # steady state, not the one-off compile
+            list_q = "WorkflowType = 'wt-3' AND CloseStatus = -1"
+            store.query("bench", list_q)
+            t0 = time.perf_counter()
+            for _ in range(trials):
+                store.query("bench", list_q)
+            list_dev_s = (time.perf_counter() - t0) / trials
+            # parity pass (outside the timed region): every query's
+            # device ids re-checked against the host evaluator
+            os.environ["CADENCE_TPU_VISIBILITY_PARITY"] = "1"
+            pre_div = reg.counter(sc, cm.M_VIS_DIVERGENCE)
+            for _name, q in queries:
+                store.count("bench", q)
+                store.query("bench", q)
+            divergence = reg.counter(sc, cm.M_VIS_DIVERGENCE) - pre_div
+            view = store._device
+            if view is not None:
+                view.stop()
+            scans = trials * len(queries)
+            out_sizes.append({
+                "rows": n,
+                "queries_per_trial": len(queries),
+                "selectivity": {k: round(v / n, 5)
+                                for k, v in sel.items()},
+                "host_rows_per_sec": round(n * scans / host_s)
+                if host_s else 0,
+                "device_rows_per_sec": round(n * scans / dev_s)
+                if dev_s else 0,
+                "speedup": round(host_s / dev_s, 3) if dev_s else 0.0,
+                "device_count_ms": round(dev_s / scans * 1000, 4),
+                "host_count_ms": round(host_s / scans * 1000, 4),
+                "device_selective_list_ms": round(list_dev_s * 1000, 4),
+                "warm_recompiles": int(warm_recompiles),
+                "parity_divergence": int(divergence),
+            })
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "sizes": out_sizes,
+        "parity": all(s["parity_divergence"] == 0 for s in out_sizes),
+        "warm_recompiles": sum(s["warm_recompiles"] for s in out_sizes),
+        "note": ("rows/s = table rows logically scanned per second of "
+                 "Count traffic (device: one mask kernel + 8-byte "
+                 "readback per query; host: index-planned per-record "
+                 "predicate). Warm recompiles across timed repeats "
+                 "must be 0; parity pass re-checks every query's ids "
+                 "against the host evaluator."),
+    }
+
+
 def _mesh_serving(workflows: int, layout):
     """The pod-scale north-star section (ISSUE 7): events/s/POD and
     per-device efficiency measured THROUGH THE SERVING EXECUTOR
@@ -1063,6 +1202,7 @@ def main() -> None:
     mesh_serving = _mesh_serving(
         int(os.environ.get("BENCH_MESH_WORKFLOWS", "4096")), layout)
     serving = _serving_suite(layout)
+    visibility = _visibility_suite()
     feeder = _feeder_rate(layout)
 
     # observability snapshot: the profiler's pack/h2d/kernel/readback leg
@@ -1098,6 +1238,7 @@ def main() -> None:
             "snapshot": snapshot,
             "mesh_serving": mesh_serving,
             "serving": serving,
+            "visibility": visibility,
             "feeder": feeder,
             "observability": observability,
         },

@@ -184,6 +184,7 @@ def main(argv=None) -> int:
                           "cache, pass 2 measures the warm hit rate; "
                           "0 = dump current stats only)")
     adm.add_parser("serving")
+    adm.add_parser("visibility")
     snp = adm.add_parser("snapshot")
     snp.add_argument("--sweep", action="store_true",
                      help="run one verify pass (seeding the resident "
@@ -233,6 +234,22 @@ def main(argv=None) -> int:
                     help="write the next LOADGEN_r0N.json in CWD")
     sv.add_argument("--out", default="",
                     help="explicit trajectory path (implies --record)")
+    # the device-visibility tier comparison (in-process, tier on vs
+    # off on the query-heavy mix; records List/Count p50/p99, the
+    # device/fallback path mix, staleness and parity counters)
+    vis = load_grp.add_parser("visibility")
+    vis.add_argument("--duration", type=float, default=4.0)
+    vis.add_argument("--rps", type=float, default=60.0,
+                     help="scheduled query-heavy arrival rate")
+    vis.add_argument("--workers", type=int, default=16)
+    vis.add_argument("--pool-size", type=int, default=8)
+    vis.add_argument("--seed", type=int, default=20260804)
+    vis.add_argument("--staleness-bound", type=int, default=64,
+                     help="max appender backlog a query may observe")
+    vis.add_argument("--record", action="store_true",
+                     help="write the next LOADGEN_r0N.json in CWD")
+    vis.add_argument("--out", default="",
+                     help="explicit trajectory path (implies --record)")
     for cmd_name in ("run", "overload"):
         lp = load_grp.add_parser(cmd_name)
         lp.add_argument("--duration", type=float, default=10.0)
@@ -256,6 +273,13 @@ def main(argv=None) -> int:
             lp.add_argument("--rps", type=float, default=3.0,
                             help="scheduled arrival rate per domain")
             lp.add_argument("--p99-slo-ms", type=float, default=2500.0)
+            lp.add_argument("--mix", default="standard",
+                            choices=("standard", "query-heavy"),
+                            help="traffic blend (loadgen/mixes.MIXES); "
+                                 "query-heavy drives List/Scan/Count — "
+                                 "set CADENCE_TPU_VISIBILITY=1 and the "
+                                 "store server serves them from the "
+                                 "columnar device tier")
         else:
             lp.add_argument("--victim-rps", type=float, default=4.0)
             lp.add_argument("--aggressor-quota-rps", type=float,
@@ -534,6 +558,11 @@ def main(argv=None) -> int:
             # the device-serving tier rollup (engine/serving.py):
             # coalescing factor, queue, path mix, parity counters
             _emit(admin.serving())
+        elif args.cmd == "visibility":
+            # the device-visibility tier rollup
+            # (engine/visibility_device.py): columns, backlog, path
+            # mix, parity + compile-cache counters
+            _emit(admin.visibility())
         elif args.cmd == "snapshot":
             # snapshot-tier rollup (engine/snapshot.py); --sweep first
             # seeds the resident pool via one verify pass and persists a
@@ -588,6 +617,11 @@ def _load_tool(args) -> int:
         doc = scenarios.serving_scenario(
             duration_s=args.duration, rps=args.rps, workers=args.workers,
             pool_size=args.pool_size, seed=args.seed)
+    elif args.cmd == "visibility":
+        doc = scenarios.visibility_scenario(
+            duration_s=args.duration, rps=args.rps, workers=args.workers,
+            pool_size=args.pool_size, seed=args.seed,
+            staleness_bound=args.staleness_bound)
     elif args.cmd == "overload":
         doc = scenarios.overload_scenario(
             duration_s=args.duration, num_hosts=args.hosts,
@@ -603,7 +637,8 @@ def _load_tool(args) -> int:
             domains=[d for d in args.domains.split(",") if d],
             rps_per_domain=args.rps, chaos_spec=args.chaos,
             seed=args.seed, p99_slo_ms=args.p99_slo_ms,
-            workers=args.workers, verify=not args.no_verify)
+            workers=args.workers, verify=not args.no_verify,
+            mix_name=args.mix)
     if args.record or args.out:
         path = lg_report.write_trajectory(doc, path=args.out or None)
         doc["trajectory"] = path

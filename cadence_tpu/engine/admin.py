@@ -240,6 +240,33 @@ class AdminHandler:
                                         m.M_SNAP_IGNORED_TORN),
         }
 
+    def visibility(self) -> Dict[str, Any]:
+        """Device-visibility tier introspection (`admin visibility` CLI
+        verb): column occupancy, intern table size, appender backlog,
+        the device-served/fallback path mix, parity counters and the
+        compile-cache hit/miss split (engine/visibility_device.py) —
+        the operator's view of how much List/Scan/Count traffic the
+        columnar scan absorbs and how fresh the device view is."""
+        self._authorize("visibility")
+        from ..utils import metrics as cm
+        from . import visibility_device as vd
+        store = self.box.stores.visibility
+        view = store._device
+        out: Dict[str, Any] = {"enabled": vd.enabled(),
+                               "attached": view is not None,
+                               "parity": vd.parity_enabled()}
+        if view is not None:
+            out.update(view.stats())
+        else:
+            reg = self.box.metrics
+            out.update({
+                "queries": reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                                       cm.M_VIS_QUERIES),
+                "parity_divergence": reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                                                 cm.M_VIS_DIVERGENCE),
+            })
+        return out
+
     def serving(self) -> Dict[str, Any]:
         """Device-serving tier introspection (`admin serving` CLI verb):
         the micro-batching transaction scheduler's knobs, queue depth,

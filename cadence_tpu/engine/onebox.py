@@ -112,6 +112,24 @@ class Onebox:
         from . import serving as serving_mod
         self.serving = (self.tpu.serving_scheduler()
                         if serving_mod.enabled() else None)
+        # columnar device visibility tier (engine/visibility_device.py,
+        # CADENCE_TPU_VISIBILITY=1): the store creates its device twin
+        # lazily on the first routed List/Scan/Count — point its
+        # tpu.visibility series at this cluster's registry, and
+        # pre-register them so a scrape always distinguishes "zero
+        # divergences" from "series missing" (the serving-tier contract)
+        self.stores.visibility.metrics = self.metrics
+        from ..utils import metrics as cm
+        for metric in (cm.M_VIS_QUERIES, cm.M_VIS_DEVICE_SERVED,
+                       cm.M_VIS_HOST_FALLBACKS,
+                       cm.M_VIS_FALLBACK_PREDICATE,
+                       cm.M_VIS_FALLBACK_COLUMN, cm.M_VIS_PARITY_CHECKS,
+                       cm.M_VIS_DIVERGENCE, cm.M_VIS_DELTAS,
+                       cm.M_VIS_DRAINS, cm.M_VIS_TOPK, cm.M_VIS_BITMAP,
+                       cm.M_VIS_TOPK_ESCALATIONS):
+            self.metrics.inc(cm.SCOPE_TPU_VISIBILITY, metric, 0)
+        self.metrics.gauge(cm.SCOPE_TPU_VISIBILITY, cm.M_VIS_STALENESS,
+                           0.0)
 
     def enable_serving(self):
         """Wire the serving tier programmatically (tests / the loadgen

@@ -26,6 +26,7 @@ All stores are thread-safe.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -795,7 +796,14 @@ class VisibilityStore:
     PLUS equality hints (visibility_query.compile_query_with_hints); the
     planner intersects index sets from the hints before evaluating the
     predicate, so selective List/Count never scans the domain — the
-    esql → index-lookup split without the ES dependency."""
+    esql → index-lookup split without the ES dependency.
+
+    Device tier (engine/visibility_device.py): when
+    CADENCE_TPU_VISIBILITY enables it, a columnar device twin of this
+    store serves query/query_page/count from HBM — this store stays the
+    WRITE-SIDE AUTHORITY (every mutation lands here first and enqueues a
+    column delta for the device view), and every device answer is parity
+    gateable against the host evaluation below."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -808,6 +816,15 @@ class VisibilityStore:
         self._by_status: Dict[Tuple[str, int], set] = {}
         #: domain → ascending [(start_time, workflow_id, run_id)]
         self._ordered: Dict[str, List[tuple]] = {}
+        #: columnar device twin (engine/visibility_device.py), attached
+        #: lazily on the first routed query when the tier is enabled
+        self._device = None
+        #: cluster registry for the device twin's tpu.visibility series
+        #: (None = the process-global default)
+        self.metrics = None
+        #: monotone mutation sequence — the device view's staleness is
+        #: measured as (this - its applied sequence)
+        self._seq = 0
 
     # -- index maintenance (held under self._lock) -------------------------
 
@@ -818,7 +835,6 @@ class VisibilityStore:
             (rec.domain_id, rec.workflow_type), set()).add(key)
         self._by_status.setdefault(
             (rec.domain_id, rec.close_status), set()).add(key)
-        import bisect
         bisect.insort(self._ordered.setdefault(rec.domain_id, []),
                       (rec.start_time, rec.workflow_id, rec.run_id))
 
@@ -830,11 +846,24 @@ class VisibilityStore:
         self._by_status.get((rec.domain_id, rec.close_status),
                             set()).discard(key)
         order = self._ordered.get(rec.domain_id, [])
-        import bisect
         entry = (rec.start_time, rec.workflow_id, rec.run_id)
         i = bisect.bisect_left(order, entry)
         if i < len(order) and order[i] == entry:
             order.pop(i)
+
+    def _notify_locked(self, rec: VisibilityRecord) -> None:
+        """Enqueue the mutated record as a column delta for the device
+        view (called under self._lock so delta order equals mutation
+        order; the device appender drains asynchronously)."""
+        self._seq += 1
+        if self._device is not None:
+            self._device.enqueue_upsert(self._seq, rec)
+
+    def _notify_delete_locked(self, rec: VisibilityRecord) -> None:
+        self._seq += 1
+        if self._device is not None:
+            self._device.enqueue_delete(
+                self._seq, (rec.domain_id, rec.workflow_id, rec.run_id))
 
     def record_started(self, rec: VisibilityRecord) -> None:
         """Upsert the open-execution record. Under a CONCURRENT task pump
@@ -853,6 +882,7 @@ class VisibilityStore:
                 self._index_remove_locked(existing)
             self._records[key] = rec
             self._index_add_locked(rec)
+            self._notify_locked(rec)
 
     def record_closed(self, domain_id: str, workflow_id: str, run_id: str,
                       close_time: int, close_status: int,
@@ -874,6 +904,7 @@ class VisibilityStore:
             rec.close_time = close_time
             rec.close_status = close_status
             self._index_add_locked(rec)
+            self._notify_locked(rec)
 
     def list_open(self, domain_id: str) -> List[VisibilityRecord]:
         with self._lock:
@@ -894,6 +925,7 @@ class VisibilityStore:
             rec = self._records.get((domain_id, workflow_id, run_id))
             if rec is not None:
                 rec.search_attrs.update(attrs)
+                self._notify_locked(rec)
 
     def _candidates_locked(self, domain_id: str, hints: dict):
         """Index-reduced candidate key set (None = the whole domain)."""
@@ -914,17 +946,74 @@ class VisibilityStore:
             out = out & s
         return out
 
+    def _device_view(self):
+        """The columnar device twin, created lazily on the first routed
+        query when CADENCE_TPU_VISIBILITY enables the tier (bootstrap
+        enqueues every existing record under the lock, so the delta
+        stream the write hooks feed is gap-free from sequence 1). The
+        cheap env probe runs before the module import, so a disabled
+        process never pays for the device tier's dependencies."""
+        import os
+        if not os.environ.get("CADENCE_TPU_VISIBILITY", "").strip():
+            return None
+        from . import visibility_device as vd
+        if not vd.enabled():
+            return None
+        if self._device is None:
+            with self._lock:
+                if self._device is None:
+                    dev = vd.DeviceVisibilityView(registry=self.metrics)
+                    for rec in self._records.values():
+                        self._seq += 1
+                        dev.enqueue_upsert(self._seq, rec)
+                    vd.register(dev)
+                    self._device = dev
+        return self._device
+
+    def _query_locked(self, domain_id: str, pred, hints
+                      ) -> List[VisibilityRecord]:
+        """Host evaluation (held under self._lock): index intersection
+        from the query's equality hints, then the compiled predicate
+        over the remainder. The device tier's parity oracle."""
+        cands = self._candidates_locked(domain_id, hints)
+        if cands is None:
+            cands = self._by_domain.get(domain_id, set())
+        return [r for r in (self._records[k] for k in cands) if pred(r)]
+
     def query(self, domain_id: str, query: str) -> List[VisibilityRecord]:
         """Query-filtered list (ListWorkflowExecutions with `query`,
-        workflowHandler.go:2837): index intersection from the query's
-        equality hints, then the compiled predicate over the remainder."""
+        workflowHandler.go:2837): the columnar device scan when the
+        tier is enabled (engine/visibility_device.py — parity-gateable,
+        falls back to the host evaluation it is gated against), else
+        index intersection + predicate on the host."""
+        dev = self._device_view()
+        if dev is not None:
+            return dev.list(self, domain_id, query)
         from .visibility_query import compile_query_with_hints
         pred, hints = compile_query_with_hints(query)
         with self._lock:
-            cands = self._candidates_locked(domain_id, hints)
-            if cands is None:
-                cands = self._by_domain.get(domain_id, set())
-            return [r for r in (self._records[k] for k in cands) if pred(r)]
+            return self._query_locked(domain_id, pred, hints)
+
+    def _query_page_locked(self, domain_id: str, pred, hints,
+                           page_size: int, next_page_token=None):
+        out: List[VisibilityRecord] = []
+        cands = self._candidates_locked(domain_id, hints)
+        order = self._ordered.get(domain_id, [])
+        hi = (len(order) if next_page_token is None
+              else bisect.bisect_left(order, tuple(next_page_token)))
+        i = hi - 1
+        while i >= 0 and len(out) < page_size:
+            st, wf, run = order[i]
+            key = (domain_id, wf, run)
+            if cands is None or key in cands:
+                rec = self._records.get(key)
+                if rec is not None and pred(rec):
+                    out.append(rec)
+            i -= 1
+        more = i >= 0 and len(out) == page_size
+        token = ((out[-1].start_time, out[-1].workflow_id, out[-1].run_id)
+                 if out and more else None)
+        return out, token
 
     def query_page(self, domain_id: str, query: str, page_size: int,
                    next_page_token=None):
@@ -932,31 +1021,23 @@ class VisibilityStore:
         an opaque resume token: (records, next_token). The token is the
         last returned record's order entry; None when the page ended the
         result set."""
+        dev = self._device_view()
+        if dev is not None:
+            return dev.page(self, domain_id, query, page_size,
+                            next_page_token)
         from .visibility_query import compile_query_with_hints
         pred, hints = compile_query_with_hints(query)
-        out: List[VisibilityRecord] = []
         with self._lock:
-            cands = self._candidates_locked(domain_id, hints)
-            order = self._ordered.get(domain_id, [])
-            import bisect
-            hi = (len(order) if next_page_token is None
-                  else bisect.bisect_left(order, tuple(next_page_token)))
-            i = hi - 1
-            while i >= 0 and len(out) < page_size:
-                st, wf, run = order[i]
-                key = (domain_id, wf, run)
-                if cands is None or key in cands:
-                    rec = self._records.get(key)
-                    if rec is not None and pred(rec):
-                        out.append(rec)
-                i -= 1
-            more = i >= 0 and len(out) == page_size
-        token = ((out[-1].start_time, out[-1].workflow_id, out[-1].run_id)
-                 if out and more else None)
-        return out, token
+            return self._query_page_locked(domain_id, pred, hints,
+                                           page_size, next_page_token)
 
     def count(self, domain_id: str, query: str = "") -> int:
-        """CountWorkflowExecutions (workflowHandler.go:3322)."""
+        """CountWorkflowExecutions (workflowHandler.go:3322): on the
+        device tier a count never materializes records — the mask
+        kernel's scalar reduction is the whole readback."""
+        dev = self._device_view()
+        if dev is not None:
+            return dev.count(self, domain_id, query)
         return len(self.query(domain_id, query))
 
     def all_closed(self) -> List[VisibilityRecord]:
@@ -969,6 +1050,7 @@ class VisibilityStore:
             rec = self._records.pop((domain_id, workflow_id, run_id), None)
             if rec is not None:
                 self._index_remove_locked(rec)
+                self._notify_delete_locked(rec)
 
 
 # ---------------------------------------------------------------------------

@@ -17,11 +17,17 @@ Fields: the built-in columns WorkflowID, WorkflowType, RunID, CloseStatus
 (numeric or a CloseStatus name), StartTime, CloseTime — plus ANY custom
 search-attribute key (UpsertWorkflowSearchAttributes decision), exactly
 the split the reference indexes into ES.
+
+The parser produces an AST (Cmp/And/Or) first, and the host predicate is
+compiled FROM the AST — the same tree the device visibility tier
+(engine/visibility_device.py) compiles into vectorized column-mask
+kernels, so the two evaluators can never drift on the grammar.
 """
 from __future__ import annotations
 
 import re
-from typing import Callable, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple, Union
 
 from ..core.enums import CloseStatus
 from .persistence import VisibilityRecord
@@ -60,6 +66,38 @@ def _tokenize(query: str) -> List[Tuple[str, str]]:
                     tokens.append((kind, val))
                 break
     return tokens
+
+
+# -- AST --------------------------------------------------------------------
+# The parse result both evaluators consume: the host predicate below and
+# the device mask compiler (ops/scan.py compile_ast). Value-typed and
+# hashable, so a query's STRUCTURE (shape + fields + ops, values
+# excluded) can key compiled kernel variants.
+
+
+@dataclass(frozen=True)
+class Cmp:
+    """One comparison leaf: `field op value` (value already normalized —
+    CloseStatus names resolved to their numeric code)."""
+
+    field: str
+    op: str
+    value: object
+
+
+@dataclass(frozen=True)
+class And:
+    left: "Node"
+    right: "Node"
+
+
+@dataclass(frozen=True)
+class Or:
+    left: "Node"
+    right: "Node"
+
+
+Node = Union[Cmp, And, Or]
 
 
 _BUILTINS = {
@@ -107,13 +145,13 @@ class _Parser:
         self.pos += 1
         return tok
 
-    def parse(self) -> Callable[[VisibilityRecord], bool]:
-        pred, self.hints = self.expr()
+    def parse(self) -> Node:
+        node, self.hints = self.expr()
         if self.peek() is not None:
             raise QueryParseError(f"trailing tokens: {self.tokens[self.pos:]}")
-        return pred
+        return node
 
-    # Each production returns (pred, hints): hints is a {field: value}
+    # Each production returns (node, hints): hints is a {field: value}
     # dict of EQUALITY constraints every matching record must satisfy —
     # AND merges them, OR discards (a disjunction guarantees nothing).
     # The store's query planner intersects index sets from these before
@@ -124,7 +162,7 @@ class _Parser:
         while self.peek() == ("bool", "OR"):
             self.take()
             right, _ = self.term()
-            left = (lambda l, r: lambda rec: l(rec) or r(rec))(left, right)
+            left = Or(left, right)
             hints = {}
         return left, hints
 
@@ -133,7 +171,7 @@ class _Parser:
         while self.peek() == ("bool", "AND"):
             self.take()
             right, rhints = self.factor()
-            left = (lambda l, r: lambda rec: l(rec) and r(rec))(left, right)
+            left = And(left, right)
             hints = {**hints, **rhints}
         return left, hints
 
@@ -164,19 +202,36 @@ class _Parser:
                         f"(one of {[s.name for s in CloseStatus]})")
         else:
             raise QueryParseError(f"expected a value, got {raw!r}")
-        compare = _OPS[op]
-
-        def pred(rec: VisibilityRecord) -> bool:
-            actual = _field_value(rec, field)
-            if actual is None:
-                return False
-            try:
-                return compare(actual, value)
-            except TypeError:
-                return False
-
         hints = {field.lower(): value} if op == "=" else {}
-        return pred, hints
+        return Cmp(field, op, value), hints
+
+
+def eval_node(node: Node, rec: VisibilityRecord) -> bool:
+    """Evaluate the AST against one record — the reference host
+    semantics both tiers are gated on: a missing field never matches,
+    and a cross-type ordering comparison (TypeError) never matches."""
+    if isinstance(node, And):
+        return eval_node(node.left, rec) and eval_node(node.right, rec)
+    if isinstance(node, Or):
+        return eval_node(node.left, rec) or eval_node(node.right, rec)
+    actual = _field_value(rec, node.field)
+    if actual is None:
+        return False
+    try:
+        return _OPS[node.op](actual, node.value)
+    except TypeError:
+        return False
+
+
+def parse_query(query: str) -> Tuple[Optional[Node], dict]:
+    """(AST, equality-hints) for a query string; (None, {}) for the
+    empty match-all query."""
+    tokens = _tokenize(query)
+    if not tokens:
+        return None, {}
+    parser = _Parser(tokens)
+    node = parser.parse()
+    return node, parser.hints
 
 
 def compile_query(query: str) -> Callable[[VisibilityRecord], bool]:
@@ -189,9 +244,7 @@ def compile_query_with_hints(query: str):
     """(predicate, equality-hints): hints map lowercased field names to
     values every matching record must carry — the store intersects its
     (type, status) indexes from them before evaluating the predicate."""
-    tokens = _tokenize(query)
-    if not tokens:
+    node, hints = parse_query(query)
+    if node is None:
         return (lambda rec: True), {}
-    parser = _Parser(tokens)
-    pred = parser.parse()
-    return pred, parser.hints
+    return (lambda rec: eval_node(node, rec)), hints

@@ -39,11 +39,14 @@ from ..utils import metrics as m
 from ..utils.circuitbreaker import ServiceBusy
 from ..utils.quotas import ServiceBusyError
 from .mixes import (
+    OP_COUNT,
     OP_CRON_START,
+    OP_LIST,
     OP_LONGPOLL,
     OP_QUERY,
     OP_RESET,
     OP_RETRY_START,
+    OP_SCAN,
     OP_SIGNAL,
     OP_SIGNAL_WITH_START,
     OP_START,
@@ -441,6 +444,13 @@ class LoadGenerator:
             client.reset_workflow_execution(
                 op.domain, op.workflow_id, decision_finish_event_id=4,
                 reason=f"loadgen-{op.index}")
+        elif op.kind == OP_LIST:
+            # arg carries the seeded visibility query (mixes.VIS_QUERIES)
+            client.list_workflow_executions(op.domain, op.arg)
+        elif op.kind == OP_SCAN:
+            client.scan_workflow_executions(op.domain, op.arg)
+        elif op.kind == OP_COUNT:
+            client.count_workflow_executions(op.domain, op.arg)
         else:
             raise ValueError(f"unknown op kind {op.kind!r}")
 

@@ -39,12 +39,37 @@ OP_SIGNAL_WITH_START = "signal-with-start"
 OP_QUERY = "query"
 OP_LONGPOLL = "longpoll"
 OP_RESET = "reset"
+#: visibility read ops (ListWorkflowExecutions / ScanWorkflowExecutions
+#: / CountWorkflowExecutions with a query string): the read side the
+#: device-visibility tier serves; `arg` carries the seeded query
+OP_LIST = "list"
+OP_SCAN = "scan"
+OP_COUNT = "count"
 
 ALL_OPS = (OP_START, OP_CRON_START, OP_RETRY_START, OP_SIGNAL,
-           OP_SIGNAL_WITH_START, OP_QUERY, OP_LONGPOLL, OP_RESET)
+           OP_SIGNAL_WITH_START, OP_QUERY, OP_LONGPOLL, OP_RESET,
+           OP_LIST, OP_SCAN, OP_COUNT)
 
 #: kinds that target the long-lived pool population
 POOL_OPS = (OP_SIGNAL, OP_QUERY, OP_LONGPOLL, OP_RESET)
+
+#: kinds that carry a visibility query string in `arg`
+VIS_OPS = (OP_LIST, OP_SCAN, OP_COUNT)
+
+#: the seeded query pool visibility ops draw from: every shape the
+#: generator's own populations produce (churn closes, pool stays open),
+#: built-ins + boolean nesting — all expressible by the device mask
+#: kernels, so a query-heavy run exercises the columnar path end to end
+VIS_QUERIES = (
+    "WorkflowType = 'lg-churn'",
+    "WorkflowType = 'lg-pool' AND CloseStatus = -1",
+    "CloseStatus = 0",
+    "CloseStatus = -1",
+    "CloseStatus = 0 OR CloseStatus = -1",
+    "WorkflowType = 'lg-churn' AND StartTime > 0",
+    "WorkflowType != 'lg-pool' AND (CloseStatus = 0 OR CloseStatus = 5)",
+    "StartTime > 0 AND CloseTime >= 0",
+)
 
 
 @dataclass(frozen=True)
@@ -93,6 +118,27 @@ STANDARD_MIX = TrafficMix("standard", {
 #: a pure-start hammer — the aggressor shape for overload scenarios
 #: (every op charges the admission limiter exactly once)
 START_ONLY_MIX = TrafficMix("start-only", {OP_START: 1.0})
+
+#: read-dominated visibility traffic (the ES-query-heavy production
+#: shape the device tier exists for): List/Scan/Count with seeded query
+#: strings against a live churn+pool population, with enough writes
+#: flowing that the device view's incremental appends stay exercised
+QUERY_HEAVY_MIX = TrafficMix("query-heavy", {
+    OP_LIST: 0.30,
+    OP_COUNT: 0.18,
+    OP_SCAN: 0.07,
+    OP_QUERY: 0.05,
+    OP_START: 0.20,
+    OP_SIGNAL: 0.12,
+    OP_SIGNAL_WITH_START: 0.08,
+})
+
+#: CLI mix selector (`load run --mix`)
+MIXES = {
+    "standard": STANDARD_MIX,
+    "start-only": START_ONLY_MIX,
+    "query-heavy": QUERY_HEAVY_MIX,
+}
 
 
 @dataclass(frozen=True)
@@ -152,10 +198,18 @@ def build_schedule(plans: Sequence[DomainPlan], duration_s: float,
                 wf = f"lg-{plan.domain}-pool-{rng.randrange(plan.pool_size)}"
             elif kind == OP_SIGNAL_WITH_START:
                 wf = f"lg-{plan.domain}-sws-{rng.randrange(plan.pool_size)}"
+            elif kind in VIS_OPS:
+                # visibility reads scan the whole domain; arg is the
+                # seeded query (drawn here so the trace digest pins it)
+                wf = f"lg-{plan.domain}-vis"
             else:  # start-shaped: unique churn id
                 wf = f"lg-{plan.domain}-{kind}-{i}"
-            arg = (f"sig-{i}" if kind in (OP_SIGNAL, OP_SIGNAL_WITH_START)
-                   else "")
+            if kind in (OP_SIGNAL, OP_SIGNAL_WITH_START):
+                arg = f"sig-{i}"
+            elif kind in VIS_OPS:
+                arg = VIS_QUERIES[rng.randrange(len(VIS_QUERIES))]
+            else:
+                arg = ""
             ops.append(ScheduledOp(index=0, at_s=round(t, 6), kind=kind,
                                    domain=plan.domain, workflow_id=wf,
                                    arg=arg))

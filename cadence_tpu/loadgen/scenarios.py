@@ -424,25 +424,38 @@ def mixed_scenario(duration_s: float = 8.0, num_hosts: int = 2,
                    chaos_spec: str = "", seed: int = 20260803,
                    p99_slo_ms: float = 2500.0,
                    workers: int = 16, verify: bool = True,
-                   pool_size: int = 6, num_shards: int = 8) -> dict:
+                   pool_size: int = 6, num_shards: int = 8,
+                   mix_name: str = "standard") -> dict:
     """Plain mixed-traffic run (no quotas): the `load run` CLI verb —
-    the baseline latency-trajectory recorder."""
+    the baseline latency-trajectory recorder. `mix_name` selects the
+    traffic blend (mixes.MIXES — `query-heavy` drives the visibility
+    read surface; set CADENCE_TPU_VISIBILITY=1 in the environment and
+    the launched store server inherits it, serving those reads from the
+    columnar device tier); visibility ops get their own per-op SLO rows
+    so the read path is gated alongside the write path."""
+    from .mixes import MIXES, VIS_OPS
+
     domains = list(domains or ["lg-a", "lg-b"])
-    plans = [DomainPlan(d, rps_per_domain, mix=STANDARD_MIX,
+    mix = MIXES.get(mix_name, STANDARD_MIX)
+    plans = [DomainPlan(d, rps_per_domain, mix=mix,
                         pool_size=pool_size) for d in domains]
     schedule = build_schedule(plans, duration_s, seed)
     load, quota_metrics, verify_doc = _run_harness(
         plans, schedule, duration_s, num_hosts, num_shards, workers,
         chaos_spec, verify)
 
-    slo_report = evaluate_slos(
-        load, [SLO(p99_ms=p99_slo_ms, max_error_rate=0.2)])
+    slos = [SLO(p99_ms=p99_slo_ms, max_error_rate=0.2)]
+    if any(mix.weights.get(op, 0) > 0 for op in VIS_OPS):
+        slos += [SLO(op=op, p99_ms=p99_slo_ms, max_error_rate=0.0)
+                 for op in VIS_OPS]
+    slo_report = evaluate_slos(load, slos)
     doc = {
         "scenario": "mixed",
         "run": {"duration_s": duration_s, "num_hosts": num_hosts,
                 "num_shards": num_shards, "seed": seed,
                 "domains": domains, "rps_per_domain": rps_per_domain,
-                "chaos": chaos_spec, "workers": workers},
+                "chaos": chaos_spec, "workers": workers,
+                "mix": mix.name},
         "traffic": load.as_dict(),
         "admission": {"scrape": quota_metrics},
         "slo": slo_report.as_dict(),
@@ -451,4 +464,160 @@ def mixed_scenario(duration_s: float = 8.0, num_hosts: int = 2,
     doc["ok"] = bool(slo_report.ok
                      and (verify_doc is None
                           or verify_doc["divergent"] == 0))
+    return doc
+
+
+def visibility_scenario(duration_s: float = 4.0, rps: float = 60.0,
+                        workers: int = 16, pool_size: int = 8,
+                        seed: int = 20260804, num_shards: int = 4,
+                        staleness_bound: int = 64) -> dict:
+    """The device-visibility tier comparison (ISSUE 12's acceptance
+    run): the SAME seeded query-heavy open-loop schedule driven twice
+    against a fresh in-process cluster — device tier OFF (host dict/set
+    indexes) then ON (columnar mask kernels, per-query parity gate) —
+    recording per-op List/Scan/Count p50/p99, the device/fallback path
+    mix, the recorded-staleness gauge, and the parity counters.
+
+    The tier's contract, gated in `doc["ok"]`:
+    - parity: every device-served query's result ids equal the host
+      store's answer under the same lock (divergence counter 0;
+      host fallbacks are COUNTED, never failures);
+    - staleness: the observed appender backlog at query time stays
+      under the configured bound (the flush keeps reads
+      read-your-writes consistent);
+    - the post-run oracle↔device verify stays green (visibility reads
+      never perturb execution state)."""
+    import os
+
+    from ..engine.onebox import Onebox
+    from ..utils import compile_cache
+    from ..utils import metrics as cm
+    from .mixes import QUERY_HEAVY_MIX, VIS_OPS, trace_digest
+
+    compile_cache.enable()
+    domain = "lg-vis"
+    plans = [DomainPlan(domain, rps, mix=QUERY_HEAVY_MIX,
+                        pool_size=pool_size)]
+    schedule = build_schedule(plans, duration_s, seed)
+    vis_ops_scheduled = sum(1 for op in schedule if op.kind in VIS_OPS)
+
+    saved = {k: os.environ.get(k) for k in
+             ("CADENCE_TPU_VISIBILITY", "CADENCE_TPU_VISIBILITY_PARITY",
+              "CADENCE_TPU_VISIBILITY_STALENESS")}
+    modes: Dict[str, dict] = {}
+    try:
+        for mode in ("off", "on"):
+            os.environ["CADENCE_TPU_VISIBILITY"] = \
+                "1" if mode == "on" else "0"
+            os.environ["CADENCE_TPU_VISIBILITY_PARITY"] = "1"
+            # the bound under test IS the view's configured bound:
+            # queries inside it may serve the lagging view (parity
+            # skipped there by design), past it they flush inline
+            os.environ["CADENCE_TPU_VISIBILITY_STALENESS"] = \
+                str(staleness_bound)
+            box = Onebox(num_hosts=1, num_shards=num_shards)
+            gen = LoadGenerator([box.frontend], schedule, plans,
+                                workers=workers, pump=box.pump_once)
+            gen.prepare(setup_deadline_s=120.0)
+            if mode == "on":
+                # warm the kernel variants OUTSIDE the measured window:
+                # one pass over the seeded query pool compiles every
+                # mask shape the schedule will replay, and a write →
+                # drain → query cycle compiles the delta-scatter apply
+                # kernel (deployment warmup, same discipline as the
+                # serving scenario — a mid-window XLA compile would
+                # stall the flush and smear the measured p99)
+                from .generator import CHURN_TYPE, churn_task_list
+                from .mixes import VIS_QUERIES
+                info = box.stores.domain.by_name(domain)
+                for q in VIS_QUERIES:
+                    box.stores.visibility.query(info.domain_id, q)
+                    box.stores.visibility.count(info.domain_id, q)
+                box.frontend.start_workflow_execution(
+                    domain, "lg-vis-warm", CHURN_TYPE,
+                    churn_task_list(domain))
+                box.pump_once()
+                for q in VIS_QUERIES[:2]:
+                    box.stores.visibility.query(info.domain_id, q)
+            load = gen.run()
+            pct_list = load.percentiles("list")
+            pct_count = load.percentiles("count")
+            t = load.totals(domain)
+            reg = box.metrics
+            sc = cm.SCOPE_TPU_VISIBILITY
+            doc_mode = {
+                "sent": t.sent, "ok": t.ok, "errors": t.errors,
+                "duration_s": round(load.duration_s, 3),
+                "list_p50_ms": round(pct_list["p50"] * 1000, 3),
+                "list_p99_ms": round(pct_list["p99"] * 1000, 3),
+                "count_p50_ms": round(pct_count["p50"] * 1000, 3),
+                "count_p99_ms": round(pct_count["p99"] * 1000, 3),
+            }
+            if mode == "on":
+                view = box.stores.visibility._device
+                staleness = reg.histogram(sc, cm.M_VIS_STALENESS)
+                doc_mode.update({
+                    "visibility": view.stats() if view is not None
+                    else {},
+                    "staleness_observed_max": (view.staleness_max
+                                               if view is not None else 0),
+                    "staleness_served_max": (view.served_staleness_max
+                                             if view is not None else 0),
+                    "staleness_p99": round(staleness.percentile(0.99), 3),
+                    "device_served": reg.counter(sc,
+                                                 cm.M_VIS_DEVICE_SERVED),
+                    "host_fallbacks": reg.counter(
+                        sc, cm.M_VIS_HOST_FALLBACKS),
+                    "parity_checks": reg.counter(sc,
+                                                 cm.M_VIS_PARITY_CHECKS),
+                    "parity_divergence": reg.counter(sc,
+                                                     cm.M_VIS_DIVERGENCE),
+                })
+                if view is not None:
+                    view.stop()
+            verify = box.tpu.verify_all()
+            doc_mode["verify"] = {"total": verify.total,
+                                  "divergent": len(verify.divergent),
+                                  "ok": bool(verify.ok)}
+            modes[mode] = doc_mode
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    on, off = modes["on"], modes["off"]
+    # the gate is on SERVED staleness: a query may observe a deeper
+    # backlog, but it must flush before serving past the bound
+    staleness_ok = on.get("staleness_served_max", 0) <= staleness_bound
+    doc = {
+        "scenario": "visibility",
+        "run": {"duration_s": duration_s, "rps": rps, "workers": workers,
+                "pool_size": pool_size, "seed": seed,
+                "num_shards": num_shards,
+                "staleness_bound": staleness_bound,
+                "vis_ops_scheduled": vis_ops_scheduled,
+                "trace_digest": trace_digest(schedule)},
+        "off": off,
+        "on": on,
+        "comparison": {
+            "list_p99_on_ms": on["list_p99_ms"],
+            "list_p99_off_ms": off["list_p99_ms"],
+            "device_served": on.get("device_served", 0),
+            "host_fallbacks": on.get("host_fallbacks", 0),
+            "parity_divergence": on.get("parity_divergence", 0),
+            "staleness_p99": on.get("staleness_p99", 0.0),
+            "staleness_observed_max": on.get("staleness_observed_max", 0),
+            "staleness_served_max": on.get("staleness_served_max", 0),
+            "staleness_ok": bool(staleness_ok),
+        },
+    }
+    doc["ok"] = bool(
+        on.get("parity_divergence", 0) == 0
+        and on.get("device_served", 0) > 0
+        and on.get("parity_checks", 0) > 0
+        and staleness_ok
+        and on["verify"]["divergent"] == 0
+        and off["verify"]["divergent"] == 0)
     return doc

@@ -59,6 +59,10 @@ SCOPE_TPU_NATIVE = "tpu.native"
 SCOPE_TPU_SERVING = "tpu.serving"
 #: M_SNAP_* (engine/snapshot.py — the persisted mutable-state tier)
 SCOPE_TPU_SNAPSHOT = "tpu.snapshot"
+#: the columnar device visibility tier (engine/visibility_device.py +
+#: ops/scan.py): List/Scan/Count served as vectorized mask kernels over
+#: device-resident columns; counters below under M_VIS_*
+SCOPE_TPU_VISIBILITY = "tpu.visibility"
 SCOPE_WORKER_RETENTION = "worker.retention"
 SCOPE_WORKER_SCAVENGER = "worker.scavenger"
 SCOPE_WORKER_SCANNER = "worker.scanner"
@@ -207,6 +211,38 @@ M_SNAP_IGNORED_STALE = "ignored-stale"
 M_SNAP_IGNORED_TORN = "ignored-torn"
 M_SNAP_BYTES = "snapshot-bytes"
 M_SNAP_ENTRIES = "snapshot-entries"
+
+#: columnar device visibility tier (engine/visibility_device.py,
+#: SCOPE_TPU_VISIBILITY): `queries` counts every routed List/Scan/Count,
+#: split into `device-served` (mask kernel answered) vs `host-fallbacks`
+#: (evaluated on the host instead — `fallback-predicate` the query uses
+#: an op/column the kernels can't express (e.g. string ordering),
+#: `fallback-column` a search-attribute column past the intern budget or
+#: type-poisoned). `parity-divergence` counts device answers that
+#: disagreed with the host oracle (served the HOST answer, gated at 0);
+#: `topk-serves` vs `bitmap-scans` splits paged readback strategies,
+#: `topk-escalations` counts pages that re-ran through the bitmap path
+#: (boundary tie / truncation). `deltas-applied`/`drains` meter the
+#: coalescing appender; `staleness-pending` is the backlog a query
+#: observed before its flush (the recorded staleness gauge), and
+#: `rows`/`attr-columns`/`interned-strings` mirror column occupancy.
+M_VIS_QUERIES = "queries"
+M_VIS_DEVICE_SERVED = "device-served"
+M_VIS_HOST_FALLBACKS = "host-fallbacks"
+M_VIS_FALLBACK_PREDICATE = "fallback-predicate"
+M_VIS_FALLBACK_COLUMN = "fallback-column"
+M_VIS_PARITY_CHECKS = "parity-checks"
+M_VIS_DIVERGENCE = "parity-divergence"
+M_VIS_TOPK = "topk-serves"
+M_VIS_BITMAP = "bitmap-scans"
+M_VIS_TOPK_ESCALATIONS = "topk-escalations"
+M_VIS_DELTAS = "deltas-applied"
+M_VIS_DRAINS = "drains"
+M_VIS_STALENESS = "staleness-pending"
+M_VIS_ROWS = "rows"
+M_VIS_ATTR_COLUMNS = "attr-columns"
+M_VIS_INTERNED = "interned-strings"
+M_VIS_SCAN_LATENCY = "scan-latency"
 
 
 def ladder_rung_rows(rung: int) -> str:

@@ -47,6 +47,12 @@
 #     transfer-included rate, holds vs the baseline's feeder rate, the
 #     suffix-append leg costs by appended events, and a warm
 #     homogeneous stream provably compiles nothing new;
+#   - the VISIBILITY gate holds (TestVisibilityGate, ISSUE 12): every
+#     device-served List/Scan/Count answers with exactly the host
+#     store's result ids (parity divergence pinned at 0), warm repeats
+#     of a seen query shape recompile nothing, and the recorded
+#     detail.visibility section carries the rows/s-scanned sweep (the
+#     device-vs-host rate gate engages on real-device recordings only);
 #   - the pure-Python wirec fallback stays byte-identical: the full
 #     feeder + wirec test suites run AGAIN with the native encoder
 #     disabled (CADENCE_TPU_NATIVE_WIREC=0), so a native-only
@@ -76,6 +82,8 @@ env BENCH_NS_WORKFLOWS="${BENCH_NS_WORKFLOWS:-16384}" \
     BENCH_INCR_LONG="${BENCH_INCR_LONG:-256}" \
     BENCH_SNAP_WORKFLOWS="${BENCH_SNAP_WORKFLOWS:-256}" \
     BENCH_SNAP_EVENTS="${BENCH_SNAP_EVENTS:-384}" \
+    BENCH_VIS_SIZES="${BENCH_VIS_SIZES:-5000,20000}" \
+    BENCH_VIS_TRIALS="${BENCH_VIS_TRIALS:-3}" \
     python bench.py > "$OUT"
 
 # mesh gate, on a virtual-device CPU mesh (the dryrun_multichip

@@ -66,6 +66,12 @@ def _fresh_default_observability():
     # test's first requests
     from cadence_tpu.utils import quotas
     quotas.reset_all()
+    # device-visibility views own daemon appender threads the same way
+    # as serving schedulers: stop them so a leaked drain never applies
+    # into the next test's registry (a stopped view restarts its thread
+    # on the next enqueue)
+    from cadence_tpu.engine import visibility_device
+    visibility_device.reset_all()
     yield
 
 

@@ -459,6 +459,81 @@ class TestServingGate:
             f"past unbatched {cur['unbatched_p99_ms']}ms")
 
 
+class TestVisibilityGate:
+    """The device-visibility gate (ISSUE 12): every device-served
+    List/Scan/Count must answer with exactly the host store's result
+    ids (divergence counter pinned at 0 — parity always, on every
+    platform), warm repeats of a seen query shape must recompile
+    NOTHING, and the recorded bench's visibility section must hold the
+    same contract. The rows/s rate gate engages only on recorded
+    real-device runs — on the shared CPU CI box the device and host
+    paths time-share the same cores, so only parity + recompiles gate
+    there."""
+
+    def test_device_parity_and_zero_warm_recompiles(self, monkeypatch):
+        import random
+
+        from cadence_tpu.engine.persistence import (
+            VisibilityRecord,
+            VisibilityStore,
+        )
+        from cadence_tpu.utils import metrics as cm
+
+        monkeypatch.setenv("CADENCE_TPU_VISIBILITY", "1")
+        monkeypatch.setenv("CADENCE_TPU_VISIBILITY_PARITY", "1")
+        rng = random.Random(77)
+        store = VisibilityStore()
+        for i in range(400):
+            store.record_started(VisibilityRecord(
+                "d", f"wf-{i}", f"r-{i}", f"t-{i % 4}",
+                start_time=1000 + i,
+                search_attrs={"P": rng.randrange(8)}))
+            if rng.random() < 0.5:
+                store.record_closed("d", f"wf-{i}", f"r-{i}",
+                                    close_time=2000 + i,
+                                    close_status=rng.randrange(3))
+        queries = ["", "CloseStatus = -1", "WorkflowType = 't-2'",
+                   "P >= 5 AND CloseStatus = 0",
+                   "StartTime > 1200 OR P < 2"]
+        reg = cm.DEFAULT_REGISTRY
+        for q in queries:  # cold pass compiles each shape once
+            store.count("d", q)
+            store.query("d", q)
+        pre_miss = reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                               cm.M_LADDER_CACHE_MISSES)
+        for _ in range(3):  # warm repeats: zero recompiles
+            for q in queries:
+                store.count("d", q)
+                store.query("d", q)
+        assert reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                           cm.M_LADDER_CACHE_MISSES) == pre_miss, \
+            "warm visibility queries recompiled kernel variants"
+        assert reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                           cm.M_VIS_DIVERGENCE) == 0
+        assert reg.counter(cm.SCOPE_TPU_VISIBILITY,
+                           cm.M_VIS_PARITY_CHECKS) >= 4 * len(queries)
+        store._device.stop()
+
+    def test_visibility_recorded_in_bench_json(self):
+        """smoke_perf.sh's recorded run must carry the visibility suite
+        with parity intact, zero warm recompiles, and — on a real
+        device — the columnar scan beating the host store."""
+        import jax
+
+        cur = _load_bench("PERF_CURRENT")["detail"].get("visibility")
+        assert cur, "current bench carries no visibility suite"
+        assert cur["parity"], "recorded visibility parity broke"
+        assert cur["warm_recompiles"] == 0, (
+            "recorded visibility run recompiled on warm repeats")
+        for row in cur["sizes"]:
+            assert row["parity_divergence"] == 0, row
+        if jax.devices()[0].platform != "cpu":
+            worst = min(row["speedup"] for row in cur["sizes"])
+            assert worst >= 1.0, (
+                f"device scan slower than the host store on a real "
+                f"device (worst speedup {worst})")
+
+
 class TestBaselineGate:
     def _load(self, env):
         return _load_bench(env)
