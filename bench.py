@@ -908,6 +908,207 @@ def _mesh_serving(workflows: int, layout):
     return out
 
 
+def _cluster_serving(layout, hosts_n: int = 0, workflows: int = 0,
+                     target_events: int = 0):
+    """Multi-host device serving (ISSUE 13): the cluster scale-out of
+    the serving tier measured in-process. Workflows partition across H
+    simulated hosts by the SAME ring the wire cluster routes with
+    (membership.HashRing + shard_id_for_workflow), each host running its
+    OWN TPUReplayEngine + ServingScheduler — independent resident pools,
+    independent drains — and every host's append round drives
+    concurrently. `events_per_sec_cluster` is the summed appended-event
+    rate over the whole fleet's wall window, recorded next to the
+    single-host `events_per_sec_pod` baseline. The migration leg then
+    proves the subsystem's state story: host A's resident rows snapshot
+    out through the shared store (engine/migration.MigrationManager),
+    host B hydrates + suffix-replays, and every migrated payload must be
+    byte-identical to the oracle. On the virtual CPU mesh all "hosts"
+    share physical cores, so cluster scaling reports coordination
+    overhead there (virtual flag), exactly like detail.mesh_serving."""
+    import threading
+
+    from cadence_tpu.core.checksum import STICKY_ROW_INDEX, payload_row
+    from cadence_tpu.engine.cache import batch_crc
+    from cadence_tpu.engine.membership import (
+        HashRing,
+        shard_id_for_workflow,
+    )
+    from cadence_tpu.engine.migration import MigrationManager
+    from cadence_tpu.engine.persistence import Stores
+    from cadence_tpu.engine.serving import ServingScheduler
+    from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+    from cadence_tpu.gen.corpus import generate_corpus
+    from cadence_tpu.oracle.state_builder import StateBuilder
+
+    hosts_n = hosts_n or int(os.environ.get("BENCH_CLUSTER_HOSTS", "2"))
+    workflows = workflows or int(os.environ.get("BENCH_CLUSTER_WORKFLOWS",
+                                                "64"))
+    target_events = target_events or int(
+        os.environ.get("BENCH_CLUSTER_EVENTS", "96"))
+    num_shards = 8
+    hists = generate_corpus("basic", num_workflows=workflows,
+                            seed=20260804, target_events=target_events)
+    appends = 4  # warm round + timed round, two batches each
+    prefix = min(len(h) for h in hists) - appends
+    assert prefix > 1, (prefix, appends)
+    keys = [("bench", f"cs-{i}", "r") for i in range(workflows)]
+    counts = {k: prefix for k in keys}
+    by_key = {k: h for k, h in zip(keys, hists)}
+
+    def read_batches(key):
+        return by_key[key][:counts[key]]
+
+    def expected_for(key):
+        ms = StateBuilder().replay_history(read_batches(key))
+        row = payload_row(ms, layout)
+        row[STICKY_ROW_INDEX] = 0
+        return row, int(ms.version_histories.current_index)
+
+    def build_fleet(n):
+        """n hosts, each owning its ring slice of the keys."""
+        ring = HashRing([f"host-{i}" for i in range(n)])
+        fleet = {}
+        for i in range(n):
+            name = f"host-{i}"
+            tpu = TPUReplayEngine(Stores(), layout)
+            sched = ServingScheduler(tpu, max_batch=8, max_wait_us=2000,
+                                     read_batches=read_batches)
+            sched.warm(e_shapes=(16, 32))
+            fleet[name] = sched
+        owned = {name: [] for name in fleet}
+        for k in keys:
+            sid = shard_id_for_workflow(k[1], num_shards)
+            owned[ring.lookup(f"shard-{sid}")].append(k)
+        return fleet, owned
+
+    def drive_fleet(fleet, owned, conc_per_host=4):
+        """One append per owned workflow on every host, all hosts
+        concurrent; returns (wall seconds, total appended events)."""
+        errs = []
+        total_events = [0]
+        lock = threading.Lock()
+        threads = []
+
+        def worker(sched, share):
+            # a raising submit/result must surface in errs, not die
+            # silently with the thread — a dropped share would publish
+            # an under-counted (but plausible) cluster rate
+            try:
+                for k in share:
+                    counts[k] += 1
+                    batch = read_batches(k)[-1]
+                    row, br = expected_for(k)
+                    ticket = sched.submit(k, row, br, batch_crc(batch))
+                    res = ticket.result(timeout=300.0)
+                    with lock:
+                        total_events[0] += len(batch.events)
+                        if not (res.ok and res.parity_ok):
+                            errs.append(res)
+            except Exception as exc:
+                with lock:
+                    errs.append(exc)
+
+        for name, sched in fleet.items():
+            share = owned[name]
+            for i in range(conc_per_host):
+                sl = share[i::conc_per_host]
+                if sl:
+                    threads.append(threading.Thread(
+                        target=worker, args=(sched, sl)))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errs, errs[:3]
+        return wall, total_events[0]
+
+    def measure(n):
+        fleet, owned = build_fleet(n)
+        # seed + warm: one cold round pins every prefix state, one
+        # append round traces the from-state shapes (untimed)
+        for name, sched in fleet.items():
+            for k in owned[name]:
+                row, br = expected_for(k)
+                sched.submit(k, row, br, batch_crc(read_batches(k)[-1]))
+            assert sched.drain(timeout=300.0)
+        drive_fleet(fleet, owned)
+        wall, events = drive_fleet(fleet, owned)
+        for sched in fleet.values():
+            sched.stop()
+        return events / wall
+
+    rate_pod = measure(1)
+    rate_cluster = measure(hosts_n)
+
+    # -- the migration leg: losing host -> shared store -> gaining host --
+    stores = Stores()
+    mig_keys = []
+    for h in hists[:16]:
+        b0 = h[0]
+        key = (b0.domain_id, b0.workflow_id, b0.run_id)
+        for b in h[:prefix]:
+            stores.history.append_batch(*key, list(b.events))
+        ms = StateBuilder().replay_history(
+            stores.history.as_history_batches(*key))
+        info = ms.execution_info
+        info.domain_id, info.workflow_id, info.run_id = key
+        stores.execution.upsert_workflow(ms)
+        mig_keys.append(key)
+    loser = TPUReplayEngine(stores, layout)
+    assert loser.verify_all().ok
+    out = MigrationManager("bench-loser", num_shards,
+                           loser).migrate_out(range(num_shards))
+    # one committed batch lands between snapshot and steal (the live
+    # suffix the gaining host must catch up)
+    for key, h in zip(mig_keys, hists):
+        stores.history.append_batch(*key, list(h[prefix].events))
+        ms = StateBuilder().replay_history(
+            stores.history.as_history_batches(*key))
+        info = ms.execution_info
+        info.domain_id, info.workflow_id, info.run_id = key
+        stores.execution.upsert_workflow(ms)
+    gainer = TPUReplayEngine(stores, layout)
+    t0 = time.perf_counter()
+    rep = MigrationManager("bench-gainer", num_shards,
+                           gainer).hydrate_shards(range(num_shards))
+    hydrate_s = time.perf_counter() - t0
+    identical = all(
+        (np.asarray(gainer.resident.entry_for(k).payload) ==
+         _expected_row_of(stores, k, layout)).all()
+        for k in mig_keys if gainer.resident.entry_for(k) is not None)
+
+    return {
+        "hosts": hosts_n,
+        "workflows": workflows,
+        "num_shards": num_shards,
+        "virtual": True,  # simulated hosts share this process's cores
+        "events_per_sec_pod": round(rate_pod),
+        "events_per_sec_cluster": round(rate_cluster),
+        "cluster_speedup": round(rate_cluster / rate_pod, 4),
+        "migration": {
+            "snapshotted_out": out.snapshotted,
+            "hydrated": rep.hydrated,
+            "cold": rep.cold,
+            "stale": rep.stale,
+            "suffix_events": rep.suffix_events,
+            "hydrate_s": round(hydrate_s, 4),
+            "parity_divergence": rep.parity_divergence,
+            "payload_identity": bool(identical and rep.hydrated > 0),
+        },
+    }
+
+
+def _expected_row_of(stores, key, layout):
+    from cadence_tpu.core.checksum import STICKY_ROW_INDEX, payload_row
+
+    ms = stores.execution.get_workflow(*key)
+    row = payload_row(ms, layout)
+    row[STICKY_ROW_INDEX] = 0
+    return row
+
+
 def _feeder_rate(layout):
     """The ingest pipeline: wire bytes → wirec encoder (native C++ fused
     pass when the .so loads — the ISSUE 9 path — byte-identical
@@ -1202,6 +1403,7 @@ def main() -> None:
     mesh_serving = _mesh_serving(
         int(os.environ.get("BENCH_MESH_WORKFLOWS", "4096")), layout)
     serving = _serving_suite(layout)
+    cluster_serving = _cluster_serving(layout)
     visibility = _visibility_suite()
     feeder = _feeder_rate(layout)
 
@@ -1222,6 +1424,10 @@ def main() -> None:
     # (per-device efficiency rides detail.mesh_serving, measured through
     # the serving executor)
     north["events_per_sec_pod"] = round(north["rate"])
+    # the cluster-scale north star: summed serving-tier append rate over
+    # every simulated host's wall window (detail.cluster_serving)
+    north["events_per_sec_cluster"] = \
+        cluster_serving["events_per_sec_cluster"]
     north["rate"] = round(north["rate"])
     print(json.dumps({
         "metric": "replay_events_per_sec_per_chip",
@@ -1238,6 +1444,7 @@ def main() -> None:
             "snapshot": snapshot,
             "mesh_serving": mesh_serving,
             "serving": serving,
+            "cluster_serving": cluster_serving,
             "visibility": visibility,
             "feeder": feeder,
             "observability": observability,

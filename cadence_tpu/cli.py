@@ -185,6 +185,21 @@ def main(argv=None) -> int:
                           "0 = dump current stats only)")
     adm.add_parser("serving")
     adm.add_parser("visibility")
+    clu = adm.add_parser("cluster")
+    clu.add_argument("--host", action="append", default=[],
+                     metavar="HOST:PORT",
+                     help="live service host to query over the wire "
+                          "(repeatable; per-host shard ownership, "
+                          "migration counters, resident occupancy — "
+                          "skips the WAL when given)")
+    clu.add_argument("--detail", action="store_true",
+                     help="include each resident row's payload CRC32 "
+                          "(the migration byte-parity probe)")
+    clu.add_argument("--drain", action="store_true",
+                     help="run the planned-rebalance drain on every "
+                          "--host first: persist a snapshot record for "
+                          "each resident row, so a following kill or "
+                          "rebalance is a warm failover")
     snp = adm.add_parser("snapshot")
     snp.add_argument("--sweep", action="store_true",
                      help="run one verify pass (seeding the resident "
@@ -250,6 +265,26 @@ def main(argv=None) -> int:
                      help="write the next LOADGEN_r0N.json in CWD")
     vis.add_argument("--out", default="",
                      help="explicit trajectory path (implies --record)")
+    # the multi-host kill-mid-traffic migration scenario (wire cluster,
+    # serving tier ON in every host; gates victim p99, zero divergence,
+    # snapshot-hydrated steals >= the floor; records events/s/cluster)
+    cl = load_grp.add_parser("cluster")
+    cl.add_argument("--duration", type=float, default=12.0)
+    cl.add_argument("--hosts", type=int, default=3)
+    cl.add_argument("--rps", type=float, default=16.0,
+                    help="scheduled victim-domain arrival rate")
+    cl.add_argument("--pool-size", type=int, default=12)
+    cl.add_argument("--kill-at", type=float, default=0.5,
+                    help="kill the victim host at this fraction of the "
+                         "run window")
+    cl.add_argument("--workers", type=int, default=24)
+    cl.add_argument("--seed", type=int, default=20260804)
+    cl.add_argument("--p99-slo-ms", type=float, default=8000.0)
+    cl.add_argument("--hydration-floor", type=float, default=0.8)
+    cl.add_argument("--record", action="store_true",
+                    help="write the next LOADGEN_r0N.json in CWD")
+    cl.add_argument("--out", default="",
+                    help="explicit trajectory path (implies --record)")
     for cmd_name in ("run", "overload"):
         lp = load_grp.add_parser(cmd_name)
         lp.add_argument("--duration", type=float, default=10.0)
@@ -298,6 +333,9 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.group == "load":
         return _load_tool(args)
+    if args.group == "admin" and args.cmd == "cluster" and args.host:
+        # wire mode: roll up live hosts without opening any WAL
+        return _cluster_tool(args)
     if not args.wal:
         parser.error(f"--wal is required for the {args.group} group")
     if args.group == "wal":
@@ -558,6 +596,21 @@ def main(argv=None) -> int:
             # the device-serving tier rollup (engine/serving.py):
             # coalescing factor, queue, path mix, parity counters
             _emit(admin.serving())
+        elif args.cmd == "cluster":
+            # in-process arm (no --host): the box's per-host shard
+            # ownership + resident/migration rollup; --drain runs the
+            # same planned-rebalance snapshot sweep the wire arm's
+            # admin_drain op does (one verify pass seeds the pool
+            # first, like `admin snapshot --sweep`)
+            out = {}
+            if args.drain:
+                admin.verify()
+                sweep = box.tpu.snapshot_sweep(force=True)
+                out["drain"] = {"considered": sweep.considered,
+                                "snapshotted": sweep.written,
+                                "skipped": sweep.considered
+                                - sweep.written}
+            _emit({**out, **admin.cluster(detail=args.detail)})
         elif args.cmd == "visibility":
             # the device-visibility tier rollup
             # (engine/visibility_device.py): columns, backlog, path
@@ -605,6 +658,36 @@ def main(argv=None) -> int:
     return 0
 
 
+def _cluster_tool(args) -> int:
+    """`admin cluster --host H:P [--host ...]` — the wire arm: each live
+    ServiceHost answers the admin_cluster op with its shard ownership,
+    serving/resident occupancy, and migration counters; --drain first
+    runs the planned-rebalance snapshot sweep on every host."""
+    from .rpc.wire import call as wire_call
+
+    doc = {}
+    rc = 0
+    for spec in args.host:
+        h, p = spec.rsplit(":", 1)
+        address = (h, int(p))
+        try:
+            if args.drain:
+                wire_call(address, ("admin_drain",), timeout=60)
+            per_host = wire_call(address,
+                                 ("admin_cluster", args.detail),
+                                 timeout=30)
+            if "resident_rows" in per_host:
+                per_host["resident_rows"] = {
+                    "|".join(k): v
+                    for k, v in per_host["resident_rows"].items()}
+            doc[spec] = per_host
+        except Exception as exc:
+            doc[spec] = {"error": f"{type(exc).__name__}: {exc}"}
+            rc = 1
+    _emit(doc)
+    return rc
+
+
 def _load_tool(args) -> int:
     """`load run` / `load overload` (cadence_tpu/loadgen/scenarios.py):
     exit 0 iff the scenario's gate held (SLOs, shed ratio, zero
@@ -622,6 +705,12 @@ def _load_tool(args) -> int:
             duration_s=args.duration, rps=args.rps, workers=args.workers,
             pool_size=args.pool_size, seed=args.seed,
             staleness_bound=args.staleness_bound)
+    elif args.cmd == "cluster":
+        doc = scenarios.cluster_serving_scenario(
+            duration_s=args.duration, num_hosts=args.hosts, rps=args.rps,
+            pool_size=args.pool_size, kill_at_frac=args.kill_at,
+            seed=args.seed, p99_slo_ms=args.p99_slo_ms,
+            workers=args.workers, hydration_floor=args.hydration_floor)
     elif args.cmd == "overload":
         doc = scenarios.overload_scenario(
             duration_s=args.duration, num_hosts=args.hosts,

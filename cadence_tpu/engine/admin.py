@@ -267,6 +267,40 @@ class AdminHandler:
             })
         return out
 
+    def cluster(self, detail: bool = False) -> Dict[str, Any]:
+        """Cluster rollup (`admin cluster` CLI verb, in-process arm):
+        per-host shard ownership, resident occupancy, and the migration
+        counters (engine/migration.py). `detail` adds each resident
+        row's payload CRC32 + branch + content address — the same
+        byte-parity probe the wire arm (`admin cluster --host H:P`,
+        the `admin_cluster` op) exposes."""
+        self._authorize("cluster")
+        from ..utils import metrics as cm
+        reg = self.box.metrics
+        sc = cm.SCOPE_TPU_MIGRATION
+        doc: Dict[str, Any] = {
+            "cluster": self.box.cluster_name,
+            "num_shards": self.box.num_shards,
+            "hosts": {h: {"owned_shards": sorted(c.owned_shards()),
+                          "assigned_shards": sorted(c.assigned_shards())}
+                      for h, c in self.box.controllers.items()},
+            "resident": self.box.tpu.resident.stats(),
+            "snapshots": self.box.stores.snapshot.stats(),
+            "migration": {
+                "migrated_out": reg.counter(sc, cm.M_MIG_OUT),
+                "migrated_in": reg.counter(sc, cm.M_MIG_IN),
+                "cold_steals": reg.counter(sc, cm.M_MIG_COLD),
+                "stale_snapshots": reg.counter(sc, cm.M_MIG_STALE),
+                "parity_divergence": reg.counter(sc, cm.M_MIG_DIVERGENCE),
+            },
+        }
+        if detail:
+            from .migration import resident_row_checksums
+            doc["resident_rows"] = {
+                "|".join(key): row for key, row in
+                resident_row_checksums(self.box.tpu.resident).items()}
+        return doc
+
     def serving(self) -> Dict[str, Any]:
         """Device-serving tier introspection (`admin serving` CLI verb):
         the micro-batching transaction scheduler's knobs, queue depth,

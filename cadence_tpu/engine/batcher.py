@@ -9,6 +9,7 @@ quotas tier (common/quotas analog).
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import List, Tuple
 
@@ -64,7 +65,6 @@ class Batcher:
         report.total = len(targets)
         self.log.info("batch starting", domain=domain, op=operation,
                       query=query, targets=report.total)
-        import time
         for rec in targets:
             while not limiter.allow():
                 time.sleep(1.0 / max(self.rps, 1.0))

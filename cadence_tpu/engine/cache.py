@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import copy
 import threading
+import zlib
 from collections import OrderedDict
 from typing import Any, Hashable, NamedTuple, Optional, Tuple
 
@@ -50,8 +51,6 @@ def batch_crc(batch) -> int:
     content address (a torn/overwritten tail changes the last batch's
     bytes, so the checksum catches every mutation the engine can
     produce; new_run_events ride the serialized form too)."""
-    import zlib
-
     from ..core.codec import serialize_history
     return zlib.crc32(serialize_history([batch]))
 

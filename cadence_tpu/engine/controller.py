@@ -33,6 +33,21 @@ class ShardController:
         self._factory = engine_factory or self._default_factory
         self._lock = threading.Lock()
         self._engines: Dict[int, HistoryEngine] = {}
+        #: shard-movement hooks (engine/migration.MigrationManager):
+        #: `on_shards_released(ids)` fires after the ring takes shards
+        #: away (engines closed — the losing side persists its resident
+        #: rows), `on_shards_acquired(ids)` after eager acquisition
+        #: creates engines for newly assigned shards (the gaining side
+        #: hydrates). Both best-effort: a hook failure must never block
+        #: membership convergence.
+        self.on_shards_released: Optional[Callable[[list], None]] = None
+        self.on_shards_acquired: Optional[Callable[[list], None]] = None
+        #: shards whose acquire hook has fired for the CURRENT ownership
+        #: epoch (cleared on release) — membership, not engine presence,
+        #: decides hook delivery: a routed request racing the ring flip
+        #: can create the engine before ensure_assigned looks, and an
+        #: existence check would then suppress the hook forever
+        self._acquire_notified: set = set()
         ring.subscribe(self._on_membership_change)
 
     def _default_factory(self, shard: ShardContext) -> HistoryEngine:
@@ -92,23 +107,44 @@ class ShardController:
         longer assigns here and eagerly acquire newly assigned ones, so
         their queues resume from persisted ack levels without waiting for a
         routed request."""
+        released = []
         with self._lock:
             for shard_id in list(self._engines.keys()):
                 if not self._owns(shard_id):
                     self._engines[shard_id].shard.close()
                     del self._engines[shard_id]
+                    released.append(shard_id)
+                    self._acquire_notified.discard(shard_id)
+        if released and self.on_shards_released is not None:
+            try:
+                self.on_shards_released(released)
+            except Exception:
+                pass  # migration is best-effort; convergence is not
         self.ensure_assigned()
 
     def ensure_assigned(self) -> None:
         """Idempotent eager acquisition of every assigned shard. Per-shard
         failures (store briefly unreachable, ring moved mid-loop) skip that
         shard — the next call, routed request, or queue pump retries; one
-        bad shard must never abort acquisition of the rest."""
+        bad shard must never abort acquisition of the rest. Newly created
+        engines fire the acquire hook (the in-migration seam) — also on a
+        later retry beat, so a shard whose first acquisition failed still
+        hydrates when it finally lands."""
+        acquired = []
         for shard_id in self.assigned_shards():
             try:
                 self.engine_for_shard(shard_id)
             except Exception:
                 continue
+            with self._lock:
+                if shard_id not in self._acquire_notified:
+                    self._acquire_notified.add(shard_id)
+                    acquired.append(shard_id)
+        if acquired and self.on_shards_acquired is not None:
+            try:
+                self.on_shards_acquired(acquired)
+            except Exception:
+                pass
 
 
 class ShardNotOwnedError(Exception):

@@ -25,7 +25,9 @@ transports park a ParkedPoll and get the sync-match callback instead.
 """
 from __future__ import annotations
 
+import heapq
 import threading
+import time as _time
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Tuple
@@ -135,7 +137,6 @@ class _TaskListManager:
 
     def _track_locked(self, task_id: int) -> None:
         if task_id and task_id not in self._outstanding:
-            import heapq
             self._outstanding.add(task_id)
             heapq.heappush(self._id_heap, task_id)
 
@@ -232,7 +233,6 @@ class _TaskListManager:
         batched; a failed delete retries on the next ack)."""
         if not task_id:
             return
-        import heapq
         with self._lock:
             self._inflight.pop(task_id, None)
             self._outstanding.discard(task_id)
@@ -464,7 +464,6 @@ class MatchingEngine:
         worker identities per task list, TTL'd by DescribeTaskList."""
         if not identity:
             return
-        import time as _time
         with self._lock:
             hist = self._pollers.setdefault((domain_id, task_list,
                                              task_type), {})
@@ -571,7 +570,6 @@ class MatchingEngine:
                 mgr = self._managers.get(key)
             if mgr is not None:
                 total += mgr.backlog()
-        import time as _time
         with self._lock:
             hist = self._pollers.get((domain_id, task_list, task_type), {})
             cutoff = _time.time() - 300  # pollerHistory's 5-minute TTL

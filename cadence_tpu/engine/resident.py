@@ -60,6 +60,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.checksum import DEFAULT_LAYOUT, PayloadLayout
@@ -256,8 +258,6 @@ class ResidentStateCache:
         row); computed once per layout from the leaf dtypes/shapes."""
         cached = self._row_bytes_cache.get(layout)
         if cached is None:
-            import jax
-
             from ..ops.state import init_state
             row = init_state(1, layout)
             cached = int(sum(leaf.nbytes
@@ -398,7 +398,6 @@ class ResidentStateCache:
             return False
         device = self.device_of(key)
         if device is not None:
-            import jax
             state_row = jax.device_put(state_row, device)
         entry = ResidentEntry(state=state_row,
                               payload=np.asarray(payload, dtype=np.int64),
@@ -511,9 +510,6 @@ class ResidentStateCache:
                       encode_suffix, results: List, report: AppendReport,
                       shard: int = 0,
                       address_of: Callable = content_address) -> None:
-        import jax
-        import jax.numpy as jnp
-
         from ..ops.encode import assemble_corpus
         from ..ops.replay import replay_from_state_to_payload
         from ..ops.state import init_state, layout_of
@@ -700,9 +696,6 @@ def _stack_states(states):
     count + leaf shapes, then a single cached dispatch per call)."""
     global _STACK_FN
     if _STACK_FN is None:
-        import jax
-        import jax.numpy as jnp
-
         def stack(ss):
             return jax.tree_util.tree_map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *ss)
@@ -719,8 +712,6 @@ def _slice_row(state, index: int):
     state shape, not per row index)."""
     global _SLICE_FN
     if _SLICE_FN is None:
-        import jax
-
         def slice_row(s, i):
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.dynamic_slice_in_dim(a, i, 1, axis=0), s)

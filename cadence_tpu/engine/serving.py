@@ -63,6 +63,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.checksum import crc32_of_row
@@ -89,6 +91,14 @@ DEFAULT_QUEUE = 4096
 #: explicit deployment choice, and the off configuration is the loadgen
 #: comparison baseline
 ENABLE_ENV = "CADENCE_TPU_SERVING"
+#: boot warm-up (ServiceHost): pre-compile the flush kernels in a
+#: background thread as the host starts, so the FIRST live drain never
+#: pays an XLA compile (default on; 0 skips — in-process clusters and
+#: tests warm explicitly where they need to)
+WARM_ENV = "CADENCE_TPU_SERVING_WARM"
+#: csv of event-axis pow2 buckets the boot warm-up compiles
+WARM_EVENTS_ENV = "CADENCE_TPU_SERVING_WARM_EVENTS"
+DEFAULT_WARM_EVENTS = (16, 32, 64, 128)
 
 #: batch-size histogram buckets (transactions per flush)
 BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
@@ -103,6 +113,21 @@ _LIVE: "weakref.WeakSet[ServingScheduler]" = weakref.WeakSet()
 
 def enabled() -> bool:
     return os.environ.get(ENABLE_ENV, "0") in ("1", "true", "on")
+
+
+def warm_on_boot() -> bool:
+    return os.environ.get(WARM_ENV, "1") not in ("0", "false", "off")
+
+
+def warm_event_shapes() -> Tuple[int, ...]:
+    raw = os.environ.get(WARM_EVENTS_ENV, "")
+    if not raw:
+        return DEFAULT_WARM_EVENTS
+    try:
+        shapes = tuple(int(s) for s in raw.split(",") if s.strip())
+    except ValueError:
+        return DEFAULT_WARM_EVENTS
+    return shapes or DEFAULT_WARM_EVENTS
 
 
 def reset_all() -> None:
@@ -760,8 +785,6 @@ class ServingScheduler:
         def build():
             from functools import partial
 
-            import jax
-
             from ..ops.payload import payload_rows
             from ..ops.replay import replay_events
 
@@ -783,8 +806,6 @@ class ServingScheduler:
         rows still get their parity settled on device through the
         escalation ladder; they just stay un-pinned (the base-layout
         pool has no state for them to re-narrow into)."""
-        import jax
-
         from ..ops.encode import NUM_LANES, assemble_corpus, gather_subcorpus
         from ..ops.state import CAPACITY_ERRORS
 
@@ -870,9 +891,6 @@ class ServingScheduler:
         bucket grows, and the next flush compiles an even bigger shape.
         Returns the number of (width, events) kernel shapes warmed (warm
         passes through the persistent compile cache return quickly)."""
-        import jax
-        import jax.numpy as jnp
-
         from ..ops.encode import NUM_LANES
         from ..ops.replay import replay_from_state_to_payload
         from ..ops.state import init_state

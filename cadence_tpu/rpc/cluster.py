@@ -98,13 +98,18 @@ class Cluster:
     def __init__(self, store_port: int, hosts: Dict[str, int],
                  procs: Dict[str, subprocess.Popen],
                  store_proc: subprocess.Popen,
-                 http_ports: Dict[str, int] = None) -> None:
+                 http_ports: Dict[str, int] = None,
+                 spawn_host=None) -> None:
         self.store_port = store_port
         self.hosts = hosts          # name → port
         self.procs = procs          # name → process
         self.store_proc = store_proc
         #: name → HTTP scrape port (/metrics, /health, /traces)
         self.http_ports = dict(http_ports or {})
+        #: launch()'s host-spawn closure (same store, same knobs) — the
+        #: planned-rebalance seam: add_host grows the ring mid-life and
+        #: the losing hosts migrate their moving shards' resident state
+        self._spawn_host = spawn_host
 
     def frontend(self, index_or_name) -> FrontendClient:
         name = (index_or_name if isinstance(index_or_name, str)
@@ -113,6 +118,42 @@ class Cluster:
 
     def ping(self, name: str):
         return call(("127.0.0.1", self.hosts[name]), ("ping",), timeout=5)
+
+    def admin(self, name: str, op: str, *args, timeout: float = 30):
+        """One admin wire op against a host (admin_metrics,
+        admin_cluster, admin_drain, ...)."""
+        return call(("127.0.0.1", self.hosts[name]), (op,) + args,
+                    timeout=timeout)
+
+    def add_host(self, name: str = "") -> str:
+        """Planned rebalance: spawn one more service host against the
+        same store server and wait until every live ring converges on
+        the grown membership (the losing hosts' shard release — and
+        their resident-state out-migration — happens on their own beat
+        threads as the ring change lands). Returns the new host name."""
+        if self._spawn_host is None:
+            raise RuntimeError("this cluster was not built by launch()")
+        name = name or f"host-{len(self.hosts)}"
+        if name in self.hosts:
+            raise ValueError(f"host {name!r} already exists")
+        port, http_port, proc = self._spawn_host(name)
+        self.hosts[name] = port
+        self.http_ports[name] = http_port
+        self.procs[name] = proc
+        _wait_listening(port, proc)
+        want = {n for n in self.hosts if self.procs[n].poll() is None}
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            views = []
+            for n in sorted(want):
+                try:
+                    views.append(set(self.ping(n)[3]))
+                except Exception:
+                    views.append(set())
+            if all(v >= want for v in views):
+                return name
+            time.sleep(0.05)
+        raise TimeoutError(f"ring never converged after adding {name}")
 
     def owned_shards(self) -> Dict[str, List[int]]:
         out = {}
@@ -371,8 +412,10 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
     hosts: Dict[str, int] = {}
     procs: Dict[str, subprocess.Popen] = {}
     http_ports: Dict[str, int] = {}
-    for i in range(num_hosts):
-        name = f"{cluster_name}-host-{i}" if peer_specs else f"host-{i}"
+
+    def spawn_host(name: str):
+        """One service-host process against this cluster's store (shared
+        by launch's initial fleet and Cluster.add_host's rebalance)."""
         port = free_port()
         http_port = free_port()
         cmd = [sys.executable, "-m", "cadence_tpu.rpc.server",
@@ -386,9 +429,11 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
             cmd += ["--peer", spec]
         host_env = dict(base_env)
         host_env.update(_role_env(env_extra, env_per_role, name, "host"))
-        procs[name] = subprocess.Popen(cmd, env=host_env)
-        hosts[name] = port
-        http_ports[name] = http_port
+        return port, http_port, subprocess.Popen(cmd, env=host_env)
+
+    for i in range(num_hosts):
+        name = f"{cluster_name}-host-{i}" if peer_specs else f"host-{i}"
+        hosts[name], http_ports[name], procs[name] = spawn_host(name)
     for name, port in hosts.items():
         _wait_listening(port, procs[name])
     # let every host's RING converge on the full peer set before handing
@@ -408,4 +453,4 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
             break
         time.sleep(0.05)
     return Cluster(store_port, hosts, procs, store_proc,
-                   http_ports=http_ports)
+                   http_ports=http_ports, spawn_host=spawn_host)

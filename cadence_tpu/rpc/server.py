@@ -294,11 +294,50 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         # a deployment choice, and verify/rebuild work without it)
         from ..engine import serving as serving_mod
         self.serving = None
+        self.tpu = None
+        self.migration = None
         if serving_mod.enabled():
             from ..engine.tpu_engine import TPUReplayEngine
             tpu = TPUReplayEngine(self.stores, self.config.payload_layout())
             tpu.metrics = self.metrics
+            self.tpu = tpu
             self.serving = tpu.serving_scheduler()
+            # live HBM state migration (engine/migration.py): shard
+            # movement snapshots this host's resident rows out and
+            # hydrates acquired shards from the SHARED snapshot store
+            # (which lives in the store-server process — records written
+            # by any host are immediately visible to every peer); wired
+            # to the controller's membership hooks below
+            from ..engine.migration import MigrationManager
+            self.migration = MigrationManager(name, num_shards, tpu,
+                                              registry=self.metrics)
+            for metric in (cm.M_MIG_OUT, cm.M_MIG_OUT_SKIPPED,
+                           cm.M_MIG_EVICTED, cm.M_MIG_IN, cm.M_MIG_COLD,
+                           cm.M_MIG_YOUNG, cm.M_MIG_STALE,
+                           cm.M_MIG_SUFFIX_EVENTS,
+                           cm.M_MIG_DIVERGENCE, cm.M_MIG_UNSTABLE):
+                self.metrics.inc(cm.SCOPE_TPU_MIGRATION, metric, 0)
+        # boot warm-up: the first live drain window must never pay an
+        # XLA compile (a mid-window compile stalls the drain → folds
+        # outgrow the warmed buckets → compile snowball; the exact
+        # failure serving_scenario's in-process warm() exists for) —
+        # background thread so the host serves immediately, flushes
+        # that race the warm just pay the compile they would have
+        # anyway; `serving_warmed` is surfaced in the admin_cluster doc
+        # so deploys/scenarios can hold traffic until the fleet is hot
+        self.serving_warmed = self.serving is None
+        if self.serving is not None and serving_mod.warm_on_boot():
+            def _warm_serving():
+                try:
+                    self.serving.warm(
+                        e_shapes=serving_mod.warm_event_shapes())
+                except Exception:
+                    pass
+                self.serving_warmed = True
+            threading.Thread(target=_warm_serving, daemon=True,
+                             name="cadence-serving-warm").start()
+        elif self.serving is not None:
+            self.serving_warmed = True
         # wire chaos can also arrive via dynamicconfig (the env var is the
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)
@@ -328,6 +367,11 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.controller = ShardController(name, num_shards, self.stores,
                                           self.ring, self.clock,
                                           engine_factory=self._make_engine)
+        if self.migration is not None:
+            self.controller.on_shards_released = \
+                self.migration.shards_released
+            self.controller.on_shards_acquired = \
+                self.migration.shards_acquired
         self.matching = RoutedMatching(self)
         self.frontend = Frontend(self.stores, self.matching, self.route,
                                  config=self.config, metrics=self.metrics,
@@ -494,6 +538,36 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         owner = self.ring.lookup(f"tasklist-{task_list}")
         return owner, self._peer_addresses.get(
             owner, (self.advertise_host, self.port))
+
+    # -- cluster rollup (the admin_cluster wire op body) --------------------
+
+    def cluster_doc(self, detail: bool = False) -> Dict[str, object]:
+        """Per-host shard ownership + device-tier occupancy: what the
+        `admin cluster` CLI verb and the multi-host scenarios roll up
+        across every live host. `detail` adds each resident row's
+        canonical payload CRC32 + branch + content address — the
+        byte-parity surface the planned-rebalance gate compares against
+        the oracle after a migration."""
+        doc: Dict[str, object] = {
+            "name": self.name,
+            "cluster": self.cluster_name,
+            "num_shards": self.num_shards,
+            "owned_shards": sorted(self.controller.owned_shards()),
+            "assigned_shards": sorted(self.controller.assigned_shards()),
+            "ring": sorted(self.ring.members()),
+            "serving": (self.serving.stats()
+                        if self.serving is not None else None),
+            "serving_warmed": bool(self.serving_warmed),
+            "resident": (self.tpu.resident.stats()
+                         if self.tpu is not None else None),
+            "migration": (self.migration.stats()
+                          if self.migration is not None else None),
+        }
+        if detail and self.tpu is not None:
+            from ..engine.migration import resident_row_checksums
+            doc["resident_rows"] = resident_row_checksums(
+                self.tpu.resident)
+        return doc
 
     # -- health (the /health probe body) -----------------------------------
 
@@ -685,6 +759,26 @@ class _Handler(socketserver.BaseRequestHandler):
             # speaks the wire need not open the HTTP port)
             result = {"snapshot": server.metrics.snapshot(),
                       "prometheus": server.metrics.to_prometheus()}
+        elif op == "admin_cluster":
+            # per-host cluster rollup (the `admin cluster` CLI verb's
+            # wire leg): shard ownership, serving/resident/migration
+            # occupancy — and with detail=True the resident rows' payload
+            # CRCs, the byte-parity probe the planned-rebalance test
+            # compares losing-host→gaining-host→oracle
+            detail = bool(req[1]) if len(req) > 1 else False
+            result = server.cluster_doc(detail=detail)
+        elif op == "admin_drain":
+            # planned-rebalance drain (engine/migration.py): persist a
+            # snapshot record for every resident row on this host so a
+            # following kill/rebalance is a warm failover by construction
+            if server.migration is None:
+                raise RuntimeError("serving tier (and migration) not "
+                                   "enabled on this host")
+            evict = bool(req[1]) if len(req) > 1 else False
+            rep = server.migration.drain_host(evict=evict)
+            result = {"shards": rep.shards, "considered": rep.considered,
+                      "snapshotted": rep.snapshotted,
+                      "skipped": rep.skipped, "evicted": rep.evicted}
         elif op == "ping":
             result = ("pong", server.name,
                       server.controller.owned_shards(),

@@ -59,6 +59,11 @@ SCOPE_TPU_NATIVE = "tpu.native"
 SCOPE_TPU_SERVING = "tpu.serving"
 #: M_SNAP_* (engine/snapshot.py — the persisted mutable-state tier)
 SCOPE_TPU_SNAPSHOT = "tpu.snapshot"
+#: live HBM state migration across the host cluster (engine/migration.py
+#: MigrationManager): shard movement snapshots resident rows out of the
+#: losing host and hydrates them on the gaining host; counters below
+#: under M_MIG_*
+SCOPE_TPU_MIGRATION = "tpu.migration"
 #: the columnar device visibility tier (engine/visibility_device.py +
 #: ops/scan.py): List/Scan/Count served as vectorized mask kernels over
 #: device-resident columns; counters below under M_VIS_*
@@ -211,6 +216,34 @@ M_SNAP_IGNORED_STALE = "ignored-stale"
 M_SNAP_IGNORED_TORN = "ignored-torn"
 M_SNAP_BYTES = "snapshot-bytes"
 M_SNAP_ENTRIES = "snapshot-entries"
+
+#: live HBM state migration (engine/migration.py, SCOPE_TPU_MIGRATION):
+#: on shard RELEASE the losing host writes checksum-gated snapshot
+#: records for its moving resident rows (`migrated-out`; gate-refused
+#: writes count `migrate-out-skipped`) and drops the local entries
+#: (`evicted-resident`); on shard ACQUIRE the gaining host hydrates the
+#: stolen shards' open workflows from the shared snapshot store —
+#: `migrated-in` counts snapshot-hydrated admits (suffix catch-up
+#: events under `suffix-events`), `cold-steals` keys with no usable
+#: record (full replay on first touch), `stale-snapshots` records whose
+#: address no longer prefixes the stored bytes. `parity-divergence`
+#: counts hydrated rows whose payload disagreed with the oracle's live
+#: state over a STABLE store (dropped, never served — gated at 0);
+#: `parity-skipped-unstable` counts comparisons skipped because a
+#: foreign commit moved the tail mid-hydration (not divergence).
+M_MIG_OUT = "migrated-out"
+M_MIG_OUT_SKIPPED = "migrate-out-skipped"
+M_MIG_EVICTED = "evicted-resident"
+M_MIG_IN = "migrated-in"
+M_MIG_COLD = "cold-steals"
+#: record-less keys at/under the young floor (migration.YOUNG_BATCHES):
+#: expected-cold per the snapshot policy's own min_events floor, kept
+#: out of the warm-failover ratio
+M_MIG_YOUNG = "young-steals"
+M_MIG_STALE = "stale-snapshots"
+M_MIG_SUFFIX_EVENTS = "suffix-events"
+M_MIG_DIVERGENCE = "parity-divergence"
+M_MIG_UNSTABLE = "parity-skipped-unstable"
 
 #: columnar device visibility tier (engine/visibility_device.py,
 #: SCOPE_TPU_VISIBILITY): `queries` counts every routed List/Scan/Count,

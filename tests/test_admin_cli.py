@@ -45,6 +45,18 @@ class TestAdminHandler:
         assert cluster["num_shards"] == 8
         assert set(cluster["hosts"]) == set(box.hosts)
 
+    def test_cluster_rollup(self, box):
+        """`admin cluster` (in-process arm): per-host shard ownership +
+        resident/snapshot/migration counters in one doc."""
+        doc = AdminHandler(box).cluster()
+        assert set(doc["hosts"]) == set(box.hosts)
+        owned = [s for h in doc["hosts"].values()
+                 for s in h["assigned_shards"]]
+        assert sorted(owned) == list(range(box.num_shards))
+        assert "entries" in doc["resident"]
+        assert "entries" in doc["snapshots"]
+        assert doc["migration"]["parity_divergence"] == 0
+
     def test_describe_queue_and_close_shard(self, box):
         admin = AdminHandler(box)
         q = admin.describe_queue(0)

@@ -1,0 +1,193 @@
+"""Multi-host device serving over the wire (ISSUE 13's acceptance gates).
+
+Marked slow+load: each test boots a real wire cluster with the serving
+tier enabled in every host process (JAX init + kernel warm-up per
+host), so they run through deploy/smoke_multihost.sh — not tier-1.
+
+- `test_kill_host_mid_traffic_migration_gate`: the production proof —
+  SIGKILL a host mid-window; victim-domain p99 holds, zero parity
+  divergence anywhere, survivors' stolen-shard admits are
+  snapshot-hydrated above the floor, and events/s/cluster is recorded
+  next to events/s/pod.
+- `test_planned_rebalance_byte_parity`: grow the cluster by one host;
+  the losing hosts snapshot their moving resident rows out through the
+  shared store, the gaining host hydrates, and every migrated row's
+  canonical payload CRC equals the oracle's — byte-identical
+  losing-host → gaining-host → oracle.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    crc32_of_row,
+    payload_row,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.load]
+
+DOMAIN = "cs-domain"
+
+
+class TestKillHostMigration:
+    def test_kill_host_mid_traffic_migration_gate(self):
+        from cadence_tpu.loadgen.scenarios import cluster_serving_scenario
+
+        duration = float(os.environ.get("CLUSTER_DURATION_S", "10"))
+        doc = cluster_serving_scenario(duration_s=duration, rps=14.0,
+                                       workers=16, verify=True)
+        fo = doc["failover"]
+        assert fo["victim_shards_taken"], fo
+        steals = fo["migrated_in"] + fo["cold_steals"] \
+            + fo["stale_snapshots"]
+        assert steals > 0, fo
+        assert fo["hydration_ratio"] >= 0.8, fo
+        assert doc["parity"]["serving_divergence"] == 0
+        assert doc["parity"]["migration_divergence"] == 0
+        assert doc["slo"]["ok"], doc["slo"]
+        assert doc["verify"]["divergent"] == 0, doc["verify"]
+        ns = doc["north_star"]
+        assert ns["events_per_sec_cluster"] > 0
+        assert ns["events_per_sec_pod"] > 0
+        assert doc["ok"], {k: doc[k] for k in ("failover", "parity",
+                                               "verify")}
+
+
+class TestPlannedRebalance:
+    def _wait(self, predicate, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.5)
+        raise TimeoutError(what)
+
+    def test_planned_rebalance_byte_parity(self):
+        from cadence_tpu.rpc.client import RemoteStores
+        from cadence_tpu.rpc.cluster import launch
+
+        env = {"CADENCE_TPU_SERVING": "1",
+               "CADENCE_TPU_SNAPSHOT_MIN_EVENTS": "1",
+               "CADENCE_TPU_SNAPSHOT_EVERY_EVENTS": "1",
+               "CADENCE_TPU_SERVING_BATCH": "8",
+               "CADENCE_TPU_SERVING_WARM_EVENTS": "16,32"}
+        cluster = launch(num_hosts=2, num_shards=8, env_extra=env)
+        try:
+            self._wait(lambda: all(
+                cluster.admin(n, "admin_cluster").get("serving_warmed")
+                for n in sorted(cluster.hosts)), 600,
+                "serving never warmed")
+            fe = cluster.frontend(0)
+            fe.register_domain(DOMAIN)
+            # a long-lived pool: start + one completed decision each,
+            # then a couple of signal rounds — committed transactions
+            # the serving tier pins as resident rows (and snapshots,
+            # policy floor 1)
+            pool = [f"cs-wf-{i}" for i in range(12)]
+            for wf in pool:
+                fe.start_workflow_execution(DOMAIN, wf, "t", "cs-tl",
+                                            execution_timeout=3600)
+            pending = set(pool)
+            deadline = time.monotonic() + 60
+            while pending and time.monotonic() < deadline:
+                resp = fe.poll_for_decision_task(DOMAIN, "cs-tl",
+                                                 wait_seconds=0.3)
+                if resp is None or resp.token is None:
+                    continue
+                fe.respond_decision_task_completed(resp.token, [])
+                pending.discard(resp.token.workflow_id)
+            assert not pending, f"pool never seeded: {sorted(pending)}"
+            for rnd in range(2):
+                for wf in pool:
+                    fe.signal_workflow_execution(
+                        DOMAIN, wf, f"cs-sig-{rnd}",
+                        request_id=f"cs-req-{rnd}-{wf}")
+            # complete the decisions the signals scheduled: pending
+            # decisions would TIME OUT mid-test on the real clock and
+            # keep committing transactions under the comparisons below
+            quiet_deadline = time.monotonic() + 60
+            idle = 0
+            while idle < 4 and time.monotonic() < quiet_deadline:
+                resp = fe.poll_for_decision_task(DOMAIN, "cs-tl",
+                                                 wait_seconds=0.3)
+                if resp is None or resp.token is None:
+                    idle += 1
+                    continue
+                idle = 0
+                fe.respond_decision_task_completed(resp.token, [])
+
+            # quiesce: every host's serving queue drained and resident
+            # rows pinned (the state the rebalance must carry)
+            def drained():
+                docs = [cluster.admin(n, "admin_cluster")
+                        for n in sorted(cluster.hosts)]
+                entries = sum((d["resident"] or {}).get("entries", 0)
+                              for d in docs)
+                depth = sum((d["serving"] or {}).get("queue_depth", 1)
+                            for d in docs)
+                return entries >= len(pool) and depth == 0
+            self._wait(drained, 120, "serving tier never quiesced")
+
+            before = {n: cluster.admin(n, "admin_cluster", True)
+                      for n in sorted(cluster.hosts)}
+            moved_rows = {}
+            for doc in before.values():
+                moved_rows.update(doc.get("resident_rows", {}))
+            assert len(moved_rows) >= len(pool)
+
+            # the planned rebalance: one more host joins the ring
+            new_host = cluster.add_host()
+            # the losers' release hooks snapshot + evict the moving
+            # rows; the gainer hydrates in the background
+            self._wait(lambda: (cluster.admin(new_host, "admin_cluster")
+                                .get("resident", {}) or {})
+                       .get("entries", 0) > 0, 300,
+                       f"{new_host} never hydrated any resident rows")
+            gained = cluster.admin(new_host, "admin_cluster", True)
+            mig = gained["migration"]
+            assert mig["migrated_in"] > 0, mig
+            assert mig["parity_divergence"] == 0, mig
+            losers_out = sum(
+                cluster.admin(n, "admin_cluster")["migration"]
+                ["migrated_out"] for n in sorted(cluster.hosts)
+                if n != new_host)
+            assert losers_out > 0
+
+            # byte parity AT THE ROW'S CONTENT ADDRESS: replay exactly
+            # the batches the pinned state covers through the oracle
+            # StateBuilder and compare CRCs — immune to any transaction
+            # that commits after the hydration pass (content addressing
+            # already guarantees such a row is never served stale)
+            from cadence_tpu.engine.cache import batch_crc
+            from cadence_tpu.oracle.state_builder import StateBuilder
+
+            stores = RemoteStores(("127.0.0.1", cluster.store_port))
+            rows = gained.get("resident_rows", {})
+            assert rows, gained
+            checked = 0
+            for key, (crc, branch, addr) in rows.items():
+                batch_count, tail_crc = addr
+                batches = stores.history.as_history_batches(*key)
+                assert batch_count <= len(batches), key
+                prefix = batches[:batch_count]
+                assert int(batch_crc(prefix[-1])) == tail_crc, key
+                ms = StateBuilder().replay_history(prefix)
+                oracle = payload_row(ms, DEFAULT_LAYOUT)
+                oracle[STICKY_ROW_INDEX] = 0
+                assert crc == int(crc32_of_row(
+                    np.asarray(oracle, dtype=np.int64))), key
+                assert branch == int(ms.version_histories.current_index)
+                checked += 1
+            assert checked > 0
+            # and the moved keys' pre-migration CRCs (read on the losing
+            # hosts) match what the gainer now serves, wherever the row
+            # still sits at the same content address
+            for key, (crc, _branch, addr) in rows.items():
+                if key in moved_rows and moved_rows[key][2] == tuple(addr):
+                    assert moved_rows[key][0] == crc, key
+        finally:
+            cluster.stop()

@@ -1,0 +1,386 @@
+"""Live HBM state migration across the host cluster (ISSUE 13).
+
+Covers: the out→in migration round trip (losing host snapshots its
+resident rows through the shared store, gaining host hydrates + replays
+only the appended suffix, payloads byte-identical to the oracle); cold
+steals and stale snapshots counted and never served; closed workflows
+skipped; hydration parity divergence detected, dropped, counted; the
+ShardController's release/acquire membership hooks; fenced-engine
+eviction under a ring flap (a deposed host that re-acquires must never
+serve the stale shard context); and the routing drift guard pinning the
+host-shard and device-shard hash paths against golden values.
+"""
+import numpy as np
+import pytest
+
+from cadence_tpu.core.checksum import (
+    DEFAULT_LAYOUT,
+    STICKY_ROW_INDEX,
+    payload_row,
+)
+from cadence_tpu.engine.cache import batch_crc
+from cadence_tpu.engine.membership import HashRing, shard_id_for_workflow
+from cadence_tpu.engine.migration import InReport, MigrationManager
+from cadence_tpu.engine.persistence import Stores
+from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.oracle.state_builder import StateBuilder
+from cadence_tpu.parallel.mesh import workflow_shard
+from cadence_tpu.utils import metrics as m
+
+NUM_SHARDS = 4
+
+
+# ---------------------------------------------------------------------------
+# routing drift guard: the two shard hash paths pinned against goldens
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingDriftGuard:
+    """Host-side routing (membership.shard_id_for_workflow — the ring's
+    unit of shard movement) and device-side placement
+    (parallel/mesh.workflow_shard — the resident pool's device axis) are
+    DIFFERENT hash functions over different inputs by design; each is
+    pinned against golden values so neither can silently change under a
+    refactor. Every persisted snapshot, resident slice, and frontend
+    route keys on one of these — a drifted hash after an upgrade would
+    scatter ownership and orphan every pinned state."""
+
+    #: workflow_id → shard over (1, 4, 8, 16, 1024) host shards
+    HOST_GOLDENS = {
+        "wf-0": [0, 1, 1, 9, 361],
+        "wf-1": [0, 3, 3, 11, 875],
+        "order-12345": [0, 2, 2, 10, 42],
+        "lg-victim-pool-3": [0, 2, 6, 14, 590],
+        "a": [0, 1, 5, 5, 117],
+        "": [0, 0, 4, 12, 636],
+    }
+    #: (domain, workflow, run) key → mesh position over (1, 2, 4, 8)
+    DEVICE_GOLDENS = {
+        ("d", "wf-0", "r1"): [0, 1, 1, 5],
+        ("dom", "order-12345", "run-7"): [0, 1, 1, 5],
+        ("d2", "lg-victim-pool-3", "r"): [0, 1, 3, 3],
+    }
+
+    def test_host_shard_goldens(self):
+        for wf, expected in self.HOST_GOLDENS.items():
+            got = [shard_id_for_workflow(wf, n)
+                   for n in (1, 4, 8, 16, 1024)]
+            assert got == expected, (wf, got, expected)
+
+    def test_device_shard_goldens(self):
+        for key, expected in self.DEVICE_GOLDENS.items():
+            got = [workflow_shard(key, n) for n in (1, 2, 4, 8)]
+            assert got == expected, (key, got, expected)
+
+    def test_hash_paths_are_intentionally_distinct(self):
+        """The two paths must not be conflated BY CODE either: host
+        routing hashes the workflow id alone (a workflow's every run
+        lands on one host shard), device placement hashes the full run
+        key (runs spread across the mesh)."""
+        a = ("d", "wf-0", "r1")
+        b = ("d", "wf-0", "r2")
+        assert shard_id_for_workflow(a[1], 1024) \
+            == shard_id_for_workflow(b[1], 1024)
+        spread = {workflow_shard(("d", "wf-0", f"r{i}"), 8)
+                  for i in range(64)}
+        assert len(spread) > 1  # runs do NOT pin to one mesh position
+
+
+# ---------------------------------------------------------------------------
+# the migration round trip
+# ---------------------------------------------------------------------------
+
+
+def _seed_open(stores, n=4, target_events=30, drop_tail=2, seed=7):
+    """Open (still-running) workflows: full histories generated, only a
+    prefix appended — the dropped tail is the live suffix later tests
+    append. Returns (keys, tails)."""
+    hists = generate_corpus("basic", num_workflows=n, seed=seed,
+                            target_events=target_events)
+    keys, tails = [], {}
+    for h in hists:
+        b0 = h[0]
+        key = (b0.domain_id, b0.workflow_id, b0.run_id)
+        kept = h[:len(h) - drop_tail]
+        tails[key] = h[len(kept):]
+        for b in kept:
+            stores.history.append_batch(*key, list(b.events))
+        _refresh_oracle(stores, key)
+        keys.append(key)
+    return keys, tails
+
+
+def _refresh_oracle(stores, key):
+    ms = StateBuilder().replay_history(
+        stores.history.as_history_batches(*key))
+    info = ms.execution_info
+    info.domain_id, info.workflow_id, info.run_id = key
+    stores.execution.upsert_workflow(ms)
+
+
+def _oracle_row(stores, key, layout=DEFAULT_LAYOUT):
+    row = payload_row(stores.execution.get_workflow(*key), layout)
+    row[STICKY_ROW_INDEX] = 0
+    return row
+
+
+class TestMigrationRoundTrip:
+    def test_out_then_hydrate_exact_byte_parity(self):
+        """Planned rebalance with no traffic in between: the gaining
+        host hydrates every row at the snapshot point — zero suffix
+        events, payloads byte-identical to the oracle."""
+        stores = Stores()
+        keys, _tails = _seed_open(stores)
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        out = MigrationManager("h-a", NUM_SHARDS, loser).migrate_out(
+            range(NUM_SHARDS), evict=True)
+        assert out.snapshotted == len(keys) and out.skipped == 0
+        assert len(loser.resident) == 0  # moved state never served here
+
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        rep = MigrationManager("h-b", NUM_SHARDS, gainer).hydrate_shards(
+            range(NUM_SHARDS))
+        assert rep.hydrated == len(keys)
+        assert rep.suffix_events == 0 and rep.cold == 0 and rep.stale == 0
+        assert rep.parity_divergence == 0
+        for key in keys:
+            entry = gainer.resident.entry_for(key)
+            assert entry is not None
+            assert (np.asarray(entry.payload)
+                    == _oracle_row(stores, key)).all()
+
+    def test_hydrate_replays_only_the_appended_suffix(self):
+        """A commit lands between snapshot and steal: hydration seeds at
+        the snapshot point and replays ONLY the new batches (the
+        O(suffix) contract), still byte-identical to the oracle."""
+        stores = Stores()
+        keys, tails = _seed_open(stores)
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        MigrationManager("h-a", NUM_SHARDS, loser).migrate_out(
+            range(NUM_SHARDS))
+        for key in keys:
+            stores.history.append_batch(*key,
+                                        list(tails[key][0].events))
+            _refresh_oracle(stores, key)
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        rep = MigrationManager("h-b", NUM_SHARDS, gainer).hydrate_shards(
+            range(NUM_SHARDS))
+        assert rep.hydrated == len(keys) and rep.parity_divergence == 0
+        assert rep.suffix_events > 0
+        for key in keys:
+            assert (np.asarray(gainer.resident.entry_for(key).payload)
+                    == _oracle_row(stores, key)).all()
+        # the hydrated pool serves the next verify as resident hits
+        r = gainer.verify_all()
+        assert r.ok and len(r.resident) == len(keys)
+
+    def test_cold_steal_and_stale_snapshot_counted(self):
+        """No record → cold steal; a record whose bytes were rewritten
+        under it (tail overwrite past the store's derived invalidation
+        window) → stale, never served."""
+        stores = Stores()
+        keys, tails = _seed_open(stores, n=3)
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        mgr = MigrationManager("h-a", NUM_SHARDS, loser)
+        mgr.migrate_out(range(NUM_SHARDS))
+        # key 0: drop its record entirely → cold steal
+        stores.snapshot.drop(keys[0])
+        # key 1: doctor the stored record's address so it no longer
+        # prefixes the stored bytes (the store's own derived
+        # invalidation would catch a real overwrite; this pins the
+        # hydration-side CRC check too)
+        rec = stores.snapshot.get(keys[1])
+        rec.last_batch_crc ^= 0x5A5A5A5A
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        rep = MigrationManager("h-b", NUM_SHARDS, gainer).hydrate_shards(
+            range(NUM_SHARDS))
+        assert rep.cold == 1
+        assert rep.stale == 1
+        assert rep.hydrated == 1
+        assert gainer.resident.entry_for(keys[0]) is None
+        assert gainer.resident.entry_for(keys[1]) is None
+
+    def test_closed_workflows_skipped(self):
+        stores = Stores()
+        hists = generate_corpus("basic", num_workflows=2, seed=9,
+                                target_events=24)
+        for h in hists:
+            b0 = h[0]
+            key = (b0.domain_id, b0.workflow_id, b0.run_id)
+            for b in h:
+                stores.history.append_batch(*key, list(b.events))
+            _refresh_oracle(stores, key)
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        MigrationManager("h-a", NUM_SHARDS, loser).migrate_out(
+            range(NUM_SHARDS))
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        rep = MigrationManager("h-b", NUM_SHARDS, gainer).hydrate_shards(
+            range(NUM_SHARDS))
+        assert rep.skipped_closed == 2 and rep.hydrated == 0
+
+    def test_hydration_parity_divergence_dropped_and_counted(self):
+        """A snapshot that disagrees with the oracle over a STABLE store
+        (doctored payload bytes) must be detected at hydration, dropped,
+        and counted — never pinned."""
+        stores = Stores()
+        keys, _tails = _seed_open(stores, n=1)
+        key = keys[0]
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        MigrationManager("h-a", NUM_SHARDS, loser).migrate_out(
+            range(NUM_SHARDS))
+        rec = stores.snapshot.get(key)
+        rec.payload = np.array(rec.payload, copy=True)
+        rec.payload[3] += 1  # a lie the blob CRC does not cover
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        reg = gainer.metrics
+        pre = reg.counter(m.SCOPE_TPU_MIGRATION, m.M_MIG_DIVERGENCE)
+        rep = MigrationManager("h-b", NUM_SHARDS, gainer).hydrate_shards(
+            range(NUM_SHARDS))
+        assert rep.parity_divergence == 1 and rep.hydrated == 0
+        assert reg.counter(m.SCOPE_TPU_MIGRATION,
+                           m.M_MIG_DIVERGENCE) == pre + 1
+        assert gainer.resident.entry_for(key) is None
+
+    def test_shard_scoped_out_migration(self):
+        """migrate_out touches ONLY the moving shards' rows; the rest
+        stay resident and serving."""
+        stores = Stores()
+        keys, _tails = _seed_open(stores, n=6, seed=11)
+        tpu = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert tpu.verify_all().ok
+        mgr = MigrationManager("h-a", NUM_SHARDS, tpu)
+        by_shard = {}
+        for key in keys:
+            by_shard.setdefault(mgr.shard_of(key), []).append(key)
+        moved = sorted(by_shard)[0]
+        mgr.migrate_out([moved], evict=True)
+        for key in keys:
+            entry = tpu.resident.entry_for(key)
+            if mgr.shard_of(key) == moved:
+                assert entry is None
+                assert stores.snapshot.get(key) is not None
+            else:
+                assert entry is not None
+
+    def test_background_hook_hydrates_and_drains(self):
+        """shards_acquired is the controller hook: background thread,
+        coalesced queue, drain() settles it."""
+        stores = Stores()
+        keys, _tails = _seed_open(stores, n=2, seed=13)
+        loser = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert loser.verify_all().ok
+        MigrationManager("h-a", NUM_SHARDS, loser).migrate_out(
+            range(NUM_SHARDS))
+        gainer = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        mgr = MigrationManager("h-b", NUM_SHARDS, gainer)
+        mgr.shards_acquired(range(NUM_SHARDS))
+        assert mgr.drain(timeout=120.0)
+        assert mgr.last_in.hydrated == len(keys)
+
+    def test_kill_switch_disables_both_directions(self, monkeypatch):
+        monkeypatch.setenv("CADENCE_TPU_MIGRATION", "0")
+        stores = Stores()
+        keys, _tails = _seed_open(stores, n=1, seed=15)
+        tpu = TPUReplayEngine(stores, DEFAULT_LAYOUT)
+        assert tpu.verify_all().ok
+        mgr = MigrationManager("h-a", NUM_SHARDS, tpu)
+        out = mgr.shards_released(list(range(NUM_SHARDS)))
+        assert out.snapshotted == 0 and len(stores.snapshot) == 0
+        assert tpu.resident.entry_for(keys[0]) is not None
+        mgr.shards_acquired(range(NUM_SHARDS))
+        assert mgr.drain(timeout=10.0)
+        assert mgr.last_in.considered == 0
+
+
+# ---------------------------------------------------------------------------
+# controller membership hooks + fenced-engine eviction under a ring flap
+# ---------------------------------------------------------------------------
+
+
+class TestControllerHooks:
+    def _controller(self, host, ring, stores):
+        from cadence_tpu.engine.controller import ShardController
+        from cadence_tpu.utils.clock import ManualTimeSource
+        return ShardController(host, NUM_SHARDS, stores, ring,
+                               ManualTimeSource())
+
+    def test_release_and_acquire_hooks_fire(self):
+        stores = Stores()
+        ring = HashRing(["h-a"])
+        ctrl = self._controller("h-a", ring, stores)
+        released, acquired = [], []
+        ctrl.on_shards_released = released.extend
+        ctrl.on_shards_acquired = acquired.extend
+        ctrl.ensure_assigned()
+        assert sorted(acquired) == list(range(NUM_SHARDS))
+        acquired.clear()
+        ring.add_member("h-b")  # rebalance: some shards move away
+        stolen = [s for s in range(NUM_SHARDS)
+                  if ring.lookup(f"shard-{s}") == "h-b"]
+        assert stolen, "ring never moved a shard (degenerate test)"
+        assert sorted(released) == sorted(stolen)
+        ring.remove_member("h-b")  # flap back: the shards return
+        assert sorted(acquired) == sorted(stolen)
+
+    def test_hook_failure_never_blocks_convergence(self):
+        stores = Stores()
+        ring = HashRing(["h-a"])
+        ctrl = self._controller("h-a", ring, stores)
+
+        def boom(_ids):
+            raise RuntimeError("migration exploded")
+
+        ctrl.on_shards_released = boom
+        ctrl.on_shards_acquired = boom
+        ring.add_member("h-b")
+        ring.remove_member("h-b")
+        assert sorted(ctrl.owned_shards()) == list(range(NUM_SHARDS))
+
+    def test_fenced_engine_evicted_on_reacquire_after_flap(self):
+        """The deposed-owner fencing probe, exercised DIRECTLY at the
+        controller (previously only through cluster tests): host A's
+        cached engine is fenced by a usurper while A is partitioned;
+        when the ring flaps A's shard back, engine_for_shard must evict
+        the stale (closed) context and build a fresh engine on a fresh
+        range — never serve the deposed one."""
+        from cadence_tpu.engine.persistence import ShardOwnershipLostError
+        from cadence_tpu.engine.shard import ShardContext
+
+        from cadence_tpu.engine.persistence import DomainInfo
+
+        stores = Stores()
+        stores.domain.register(DomainInfo(domain_id="mig-d", name="mig-d"))
+        ring = HashRing(["h-a"])
+        ctrl = self._controller("h-a", ring, stores)
+        wf = "wf-flap"
+        sid = ctrl.shard_for(wf)
+        engine = ctrl.engine_for_shard(sid)
+        old_range = engine.shard.range_id
+        engine.start_workflow("mig-d", wf, "t", "tl")
+
+        # partition: the ring drops h-a (it does not notice — the
+        # listener fires, but the cached engine object is what a stale
+        # in-flight request would still hold); a usurper bumps the range
+        ring.add_member("usurper")
+        usurper_ctx = ShardContext(sid, "usurper", stores)
+        usurper_ctx.acquire()
+
+        # the deposed context is fenced at the store on its next write
+        with pytest.raises(ShardOwnershipLostError):
+            engine.signal_workflow("mig-d", wf, "stale-probe")
+        assert engine.shard.is_closed
+
+        # flap: the shard comes back to h-a — the controller must NOT
+        # hand out the fenced engine it still caches
+        ring.remove_member("usurper")
+        fresh = ctrl.engine_for_shard(sid)
+        assert fresh is not engine
+        assert not fresh.shard.is_closed
+        assert fresh.shard.range_id > old_range
+        fresh.signal_workflow("mig-d", wf, "post-flap")  # serves again
