@@ -1376,6 +1376,57 @@ def _serving_suite(layout, workflows: int = 0, target_events: int = 0,
     return suite
 
 
+def _fuzz_suite(layout, trials: int = 0):
+    """Promoted fuzz corpora as permanent bench suites (ROADMAP item 4):
+    every fuzz_specs/*.json (written by `fuzz promote`, gen/fuzz.py
+    CorpusSpec) regenerates byte-identically from its seed, replays on
+    the wirec path for a timed rate, and parity-gates the CRCs against
+    the oracle — a discovered adversarial structure stays both a perf
+    input and a correctness gate. Empty when nothing is promoted."""
+    import jax.numpy as jnp
+
+    from cadence_tpu.core.checksum import crc32_of_row
+    from cadence_tpu.gen import fuzz as fuzz_mod
+    from cadence_tpu.native.wirec import pack_wirec_auto
+    from cadence_tpu.ops.encode import LANE_EVENT_ID, encode_corpus
+    from cadence_tpu.ops.replay import replay_wirec_to_crc
+
+    trials = trials or int(os.environ.get("BENCH_TRIALS", "5"))
+    table = {}
+    for spec in fuzz_mod.load_specs(os.path.dirname(
+            os.path.abspath(__file__))):
+        histories = spec.generate()
+        events_np = encode_corpus(histories)
+        real = int((events_np[:, :, LANE_EVENT_ID] > 0).sum())
+        corpus = pack_wirec_auto(events_np)
+        arrs = (jnp.asarray(corpus.slab), jnp.asarray(corpus.bases),
+                jnp.asarray(corpus.n_events))
+        crc, errors = replay_wirec_to_crc(*arrs, corpus.profile, layout)
+        crc = np.asarray(crc).astype(np.uint32)
+        errors = np.asarray(errors)
+        rates = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            c, e = replay_wirec_to_crc(*arrs, corpus.profile, layout)
+            np.asarray(c)
+            rates.append(real / (time.perf_counter() - t0))
+        expected = np.array([
+            crc32_of_row(fuzz_mod.oracle_final_row(h, layout))
+            for h in histories], dtype=np.uint32)
+        clean = errors == 0
+        table[spec.name] = {
+            "seed": spec.seed, "profile": spec.profile,
+            "workflows": len(histories), "events": real,
+            "digest": spec.digest[:12],
+            "rate_median": round(statistics.median(rates)),
+            "rate_min": round(min(rates)),
+            "error_workflows": int((~clean).sum()),
+            "crc_parity": bool((crc[clean] == expected[clean]).all()),
+            "note": spec.note,
+        }
+    return table
+
+
 def main() -> None:
     ns_workflows = int(os.environ.get("BENCH_NS_WORKFLOWS", "1000000"))
     ns_events = int(os.environ.get("BENCH_NS_EVENTS", "1000"))
@@ -1406,6 +1457,7 @@ def main() -> None:
     cluster_serving = _cluster_serving(layout)
     visibility = _visibility_suite()
     feeder = _feeder_rate(layout)
+    fuzz = _fuzz_suite(layout)
 
     # observability snapshot: the profiler's pack/h2d/kernel/readback leg
     # decomposition (fed by the instrumented feeder path) plus every tpu.*
@@ -1447,6 +1499,7 @@ def main() -> None:
             "cluster_serving": cluster_serving,
             "visibility": visibility,
             "feeder": feeder,
+            "fuzz": fuzz,
             "observability": observability,
         },
     }))

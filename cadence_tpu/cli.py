@@ -230,6 +230,46 @@ def main(argv=None) -> int:
     crun.add_argument("--cycles", type=int, default=10)
     crun.add_argument("--interval", type=float, default=0.0)
 
+    # generative fuzzer (gen/fuzz.py, gen/shrink.py, gen/interleave.py):
+    # seeded corpora over the full 13-decision surface, parity-gated on
+    # oracle<->device checksums; shrink failures to minimal batch
+    # sequences; promote interesting shapes into named bench specs
+    fz = sub.add_parser("fuzz").add_subparsers(dest="cmd", required=True)
+    fr = fz.add_parser("run")
+    fr.add_argument("--seeds", type=int, default=50)
+    fr.add_argument("--workflows", type=int, default=4,
+                    help="workflows per seed (profiles rotate per slot)")
+    fr.add_argument("--events", type=int, default=100)
+    fr.add_argument("--profile", default="",
+                    help="restrict to one profile (default: rotate all)")
+    fr.add_argument("--interleave", action="store_true",
+                    help="also run one seeded interleaving scenario "
+                         "(serving tier + wire/store chaos + crashpoint "
+                         "kills) and gate zero divergence")
+    fr.add_argument("--interleave-seed", type=int, default=20260804)
+    fr.add_argument("--record", action="store_true",
+                    help="write the next FUZZ_r0N.json in CWD")
+    fr.add_argument("--out", default="",
+                    help="explicit trajectory path (implies --record)")
+    fs = fz.add_parser("shrink")
+    fs.add_argument("--seed", type=int, required=True)
+    fs.add_argument("--index", type=int, default=0)
+    fs.add_argument("--events", type=int, default=100)
+    fs.add_argument("--profile", default="mixed")
+    fs.add_argument("--poison", default="",
+                    help="inject a deterministic device-side defect on "
+                         "this signal name (harness validation mode); "
+                         "default: shrink a REAL parity divergence")
+    fp = fz.add_parser("promote")
+    fp.add_argument("--name", required=True)
+    fp.add_argument("--seed", type=int, required=True)
+    fp.add_argument("--workflows", type=int, default=64)
+    fp.add_argument("--events", type=int, default=100)
+    fp.add_argument("--profile", default="mixed")
+    fp.add_argument("--note", default="")
+    fp.add_argument("--root", default=".",
+                    help="repo root holding fuzz_specs/")
+
     # open-loop load harness (bench/ + canary/ load tooling,
     # cadence_tpu/loadgen/): launches a REAL wire cluster, drives seeded
     # open-loop traffic, evaluates latency SLOs, optionally records a
@@ -331,6 +371,8 @@ def main(argv=None) -> int:
                                  "'rate=0.04,seed=13'")
 
     args = parser.parse_args(argv)
+    if args.group == "fuzz":
+        return _fuzz_tool(args)
     if args.group == "load":
         return _load_tool(args)
     if args.group == "admin" and args.cmd == "cluster" and args.host:
@@ -686,6 +728,60 @@ def _cluster_tool(args) -> int:
             rc = 1
     _emit(doc)
     return rc
+
+
+def _fuzz_tool(args) -> int:
+    """`fuzz run` / `fuzz shrink` / `fuzz promote` (gen/fuzz.py,
+    gen/shrink.py, gen/interleave.py): exit 0 iff the run's gates held
+    (zero oracle<->device divergence, all 13 decision types covered,
+    clean interleaving when requested)."""
+    _ensure_jax_backend()
+    from .gen import fuzz as fuzz_mod
+
+    if args.cmd == "run":
+        profiles = ((args.profile,) if args.profile
+                    else fuzz_mod.PROFILES)
+        doc = fuzz_mod.parity_run(
+            seeds=args.seeds, workflows_per_seed=args.workflows,
+            target_events=args.events, profiles=profiles)
+        if args.interleave:
+            from .gen.interleave import interleave_scenario
+            ilv = interleave_scenario(seed=args.interleave_seed)
+            doc["interleave"] = ilv
+            doc["ok"] = bool(doc["ok"] and ilv["ok"])
+        if args.record or args.out:
+            doc["trajectory"] = fuzz_mod.write_fuzz_trajectory(
+                doc, path=args.out or None)
+        _emit(doc)
+        return 0 if doc["ok"] else 1
+
+    if args.cmd == "shrink":
+        from .gen import shrink as shrink_mod
+        predicate = (shrink_mod.poisoned_parity_predicate(args.poison)
+                     if args.poison else shrink_mod.parity_predicate())
+        full = fuzz_mod.generate_fuzz_history(args.seed, args.index,
+                                              args.events, args.profile)
+        if not predicate(full):
+            _emit({"seed": args.seed, "workflow_index": args.index,
+                   "profile": args.profile, "failing": False,
+                   "note": "history does not fail the predicate — "
+                           "nothing to shrink"})
+            return 0
+        report = shrink_mod.shrink_history(
+            args.seed, args.index, predicate,
+            target_events=args.events, profile=args.profile)
+        _emit({"failing": True, **report.summary()})
+        return 0
+
+    # promote
+    spec = fuzz_mod.make_spec(args.name, args.seed, args.workflows,
+                              args.events, profile=args.profile,
+                              note=args.note)
+    path = fuzz_mod.save_spec(spec, root=args.root)
+    _emit({"promoted": spec.name, "path": path, "seed": spec.seed,
+           "workflows": spec.workflows, "target_events": spec.target_events,
+           "profile": spec.profile, "digest": spec.digest})
+    return 0
 
 
 def _load_tool(args) -> int:

@@ -12,18 +12,57 @@ from __future__ import annotations
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.enums import EMPTY_EVENT_ID, WorkflowState
 from ..core.events import HistoryEvent, RetryPolicy
 from ..oracle.mutable_state import MutableState
 from ..utils import metrics as m
 from ..utils import tracing
-from .history_engine import Decision, HistoryEngine, TaskToken
+from ..utils.clock import RealTimeSource
+from ..utils.dynamicconfig import (
+    KEY_FRONTEND_BURST,
+    KEY_FRONTEND_DOMAIN_RPS,
+    KEY_FRONTEND_RPS,
+    KEY_HISTORY_PAGE_SIZE,
+    KEY_RETENTION_DAYS_DEFAULT,
+    KEY_VISIBILITY_PAGE_SIZE,
+    DynamicConfig,
+)
+from ..utils.quotas import MultiStageRateLimiter, ServiceBusyError
+from .authorization import (
+    PERMISSION_ADMIN,
+    PERMISSION_WRITE,
+    AuthAttributes,
+    NoopAuthorizer,
+    check,
+)
+from .domain import (
+    deprecate_domain,
+    require_active,
+    require_startable,
+    update_domain,
+)
+from .history_engine import (
+    Decision,
+    HistoryEngine,
+    InvalidRequestError,
+    TaskToken,
+)
+from .limits import check_blob_size
 from .matching import (
     TASK_LIST_TYPE_ACTIVITY,
     TASK_LIST_TYPE_DECISION,
     MatchedTask,
     MatchingEngine,
 )
-from .persistence import DomainInfo, Stores, VisibilityRecord
+from .pagination import (
+    HistoryPage,
+    VisibilityPage,
+    decode_token,
+    encode_token,
+)
+from .archival import archiver_for
+from .cluster import ClusterMetadata
+from .persistence import DomainInfo, EntityNotExistsError, Stores, VisibilityRecord
 
 
 class PollDecisionResponse:
@@ -57,26 +96,16 @@ class Frontend:
                  router: Callable[[str], HistoryEngine],
                  config=None, metrics=None, time_source=None,
                  cluster_name: str = "primary") -> None:
-        from ..utils.clock import RealTimeSource
-        from ..utils.dynamicconfig import (
-            KEY_FRONTEND_BURST,
-            KEY_FRONTEND_DOMAIN_RPS,
-            KEY_FRONTEND_RPS,
-            DynamicConfig,
-        )
-        from ..utils.quotas import MultiStageRateLimiter
         self.stores = stores
         self.matching = matching
         self.router = router
         self.cluster_name = cluster_name
         # authorization seam: Noop by default (reference posture); hosts
         # inject a real authorizer + per-connection actor identity
-        from .authorization import NoopAuthorizer
         self.authorizer = NoopAuthorizer()
         self.actor = ""
         #: the cluster group this frontend validates replication configs
         #: against (cluster/metadata.go); multi-cluster wiring replaces it
-        from .cluster import ClusterMetadata
         self.cluster_meta = ClusterMetadata()
         #: set by multi-cluster wiring: domain mutations stream to peers
         #: (common/domain/replication_queue.go producer seam)
@@ -108,7 +137,6 @@ class Frontend:
         the door, never by queueing into latency collapse. Every decision
         lands on the `quotas` scope (admitted/shed + per-domain series),
         so a /metrics scrape shows WHICH domain is being shed."""
-        from ..utils.quotas import ServiceBusyError
         try:
             self.rate_limiter.admit(domain)
         except ServiceBusyError:
@@ -136,7 +164,6 @@ class Frontend:
         return m.domain_metric(name, domain)
 
     def _authorize(self, api: str, permission: str, domain: str = "") -> None:
-        from .authorization import AuthAttributes, check
         check(self.authorizer, AuthAttributes(api=api, permission=permission,
                                               domain=domain,
                                               actor=self.actor))
@@ -151,9 +178,7 @@ class Frontend:
                         domain_id: str = "") -> str:
         """Domain CRUD (workflowHandler.go:265). Global domains pass the same
         domain_id on every cluster (the domain-replication invariant)."""
-        from .authorization import PERMISSION_ADMIN
         self._authorize("RegisterDomain", PERMISSION_ADMIN, name)
-        from ..utils.dynamicconfig import KEY_RETENTION_DAYS_DEFAULT
         if retention_days <= 0:
             retention_days = int(self.config.get(KEY_RETENTION_DAYS_DEFAULT))
         domain_id = domain_id or str(uuid.uuid4())
@@ -180,9 +205,7 @@ class Frontend:
         (retention feeds the scavenger, failover-version bump stamps later
         events, archival URI arms archive-then-delete),
         notification-version ordered."""
-        from .authorization import PERMISSION_ADMIN
         self._authorize("UpdateDomain", PERMISSION_ADMIN, name)
-        from .domain import update_domain
         info = update_domain(self.stores, name,
                              local_cluster=self.cluster_name,
                              meta=self.cluster_meta,
@@ -197,9 +220,7 @@ class Frontend:
 
     def deprecate_domain(self, name: str) -> DomainInfo:
         """DeprecateDomain: rejects new starts, running workflows finish."""
-        from .authorization import PERMISSION_ADMIN
         self._authorize("DeprecateDomain", PERMISSION_ADMIN, name)
-        from .domain import deprecate_domain
         info = deprecate_domain(self.stores, name)
         if self.domain_replication_publisher is not None and len(
                 info.clusters) > 1:
@@ -221,15 +242,12 @@ class Frontend:
                                  retry_policy: Optional[RetryPolicy] = None,
                                  input_payload: bytes = b"",
                                  ) -> str:
-        from .authorization import PERMISSION_WRITE
-        from .limits import check_blob_size
         self._authorize("StartWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_START)
         self.metrics.inc(m.SCOPE_FRONTEND_START, m.M_REQUESTS)
         check_blob_size(input_payload, self.config,
                         "StartWorkflowExecution", domain,
                         metrics=self.metrics)
-        from .domain import require_active, require_startable
         info = self.stores.domain.by_name(domain)
         require_startable(info)
         require_active(info, self.cluster_name)
@@ -253,10 +271,8 @@ class Frontend:
                                   request_id: Optional[str] = None) -> None:
         """request_id (SignalWorkflowExecutionRequest.RequestId) dedups
         client retries: a signal already applied under the same id no-ops."""
-        from .authorization import PERMISSION_WRITE
         self._authorize("SignalWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
-        from .domain import require_active
         info = self.stores.domain.by_name(domain)
         require_active(info, self.cluster_name)
         self.router(workflow_id).signal_workflow(info.domain_id, workflow_id,
@@ -267,16 +283,16 @@ class Frontend:
             self, domain: str, workflow_id: str, signal_name: str,
             workflow_type: str, task_list: str,
             execution_timeout: int = 3600, decision_timeout: int = 10,
-            cron_schedule: str = "", retry_policy=None) -> str:
+            cron_schedule: str = "", retry_policy=None,
+            request_id: Optional[str] = None) -> str:
         """SignalWithStartWorkflowExecution (workflowHandler.go:2494):
         signal the running execution, or atomically start one whose first
         transaction carries the signal. Returns the run ID signaled or
-        started."""
-        from .authorization import PERMISSION_WRITE
+        started. `request_id` dedups client retries on BOTH arms (the
+        start's create request id and the signal's at-least-once set)."""
         self._authorize("SignalWithStartWorkflowExecution", PERMISSION_WRITE,
                         domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
-        from .domain import require_active, require_startable
         info = self.stores.domain.by_name(domain)
         require_startable(info)
         require_active(info, self.cluster_name)
@@ -284,12 +300,10 @@ class Frontend:
             info.domain_id, workflow_id, signal_name, workflow_type,
             task_list, execution_timeout=execution_timeout,
             decision_timeout=decision_timeout, cron_schedule=cron_schedule,
-            retry_policy=retry_policy)
+            retry_policy=retry_policy, request_id=request_id)
 
     def request_cancel_workflow_execution(self, domain: str, workflow_id: str,
                                           run_id: Optional[str] = None) -> None:
-        from .authorization import PERMISSION_WRITE
-        from .domain import require_active
         self._authorize("RequestCancelWorkflowExecution", PERMISSION_WRITE,
                         domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
@@ -301,8 +315,6 @@ class Frontend:
     def terminate_workflow_execution(self, domain: str, workflow_id: str,
                                      run_id: Optional[str] = None,
                                      reason: str = "") -> None:
-        from .authorization import PERMISSION_WRITE
-        from .domain import require_active
         self._authorize("TerminateWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_SIGNAL)
         info = self.stores.domain.by_name(domain)
@@ -317,8 +329,6 @@ class Frontend:
                                  reason: str = "") -> str:
         """ResetWorkflowExecution (workflowHandler.go:2726): returns the new
         run ID."""
-        from .authorization import PERMISSION_WRITE
-        from .domain import require_active
         self._authorize("ResetWorkflowExecution", PERMISSION_WRITE, domain)
         self._admit(domain, m.SCOPE_FRONTEND_RESET)
         info = self.stores.domain.by_name(domain)
@@ -364,8 +374,6 @@ class Frontend:
                 token=None, history=history, previous_started_event_id=0,
                 queries=engine.queries.attach(key), query_only=True,
                 execution=key)
-        from .history_engine import InvalidRequestError
-        from .persistence import EntityNotExistsError
         try:
             token = engine.record_decision_task_started(
                 task.domain_id, task.workflow_id, task.run_id,
@@ -411,7 +419,6 @@ class Frontend:
 
     def _dispatch_buffered_queries(self, domain_id: str, workflow_id: str,
                                    run_id: str) -> None:
-        from ..core.enums import EMPTY_EVENT_ID, WorkflowState
         engine = self.router(workflow_id)
         key = (domain_id, workflow_id, run_id)
         buffered = engine.queries.buffered_ids(key)
@@ -443,8 +450,6 @@ class Frontend:
         pending or in flight answers with that decision's completion
         (consistent query); an idle workflow gets a query-only task
         dispatched directly through matching."""
-        from ..core.enums import EMPTY_EVENT_ID, WorkflowState
-        from .history_engine import InvalidRequestError
         self._admit(domain, m.SCOPE_FRONTEND_QUERY)
         domain_id = self.stores.domain.by_name(domain).domain_id
         engine = self.router(workflow_id)
@@ -495,8 +500,6 @@ class Frontend:
         except Exception:
             self.matching.requeue_task(task, TASK_LIST_TYPE_ACTIVITY)
             raise
-        from .history_engine import InvalidRequestError
-        from .persistence import EntityNotExistsError
         try:
             token = engine.record_activity_task_started(
                 task.domain_id, task.workflow_id, task.run_id,
@@ -543,13 +546,11 @@ class Frontend:
         info = self.stores.domain.by_name(domain)
         domain_id = info.domain_id
         engine = self.router(workflow_id)
-        from .persistence import EntityNotExistsError
 
         def read_paged() -> List[HistoryEvent]:
             # the full convenience read drives the RANGED store read in
             # pages (state_rebuilder.go:114's paginated replay posture):
             # no single store call moves unbounded bytes
-            from ..utils.dynamicconfig import KEY_HISTORY_PAGE_SIZE
             cap = int(self.config.get(KEY_HISTORY_PAGE_SIZE, domain=domain))
             out: List[HistoryEvent] = []
             from_id = 1
@@ -571,7 +572,6 @@ class Frontend:
             # domain archives stays readable (common/archiver Get path).
             # With no run_id (the scavenge also dropped the current-run
             # pointer), the most recently closed archived run serves.
-            from .archival import archiver_for
             archiver = archiver_for(info.history_archival_uri)
             if archiver is None:
                 raise
@@ -602,8 +602,6 @@ class Frontend:
         The store read itself is RANGED, so a page never moves more than
         page_size events — the contract the CLI, the archiver, and any
         long-history consumer page through."""
-        from ..utils.dynamicconfig import KEY_HISTORY_PAGE_SIZE
-        from .pagination import HistoryPage, decode_token, encode_token
 
         cap = int(self.config.get(KEY_HISTORY_PAGE_SIZE, domain=domain))
         page_size = min(page_size, cap) if page_size > 0 else cap
@@ -661,8 +659,6 @@ class Frontend:
         """Paginated List/Scan: StartTime-DESC pages with an opaque resume
         token (the ES search_after token reframed onto the store's
         time-ordered index)."""
-        from ..utils.dynamicconfig import KEY_VISIBILITY_PAGE_SIZE
-        from .pagination import VisibilityPage, decode_token, encode_token
 
         cap = int(self.config.get(KEY_VISIBILITY_PAGE_SIZE, domain=domain))
         page_size = min(page_size, cap) if page_size > 0 else cap

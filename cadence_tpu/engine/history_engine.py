@@ -24,9 +24,10 @@ from __future__ import annotations
 import copy
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..core.checksum import Checksum
+from ..core.codec import serialize_history
 from ..core.enums import (
     BUFFERED_EVENT_ID,
     EMPTY_EVENT_ID,
@@ -48,6 +49,7 @@ from ..utils import tracing
 from ..utils.clock import TimeSource
 from ..utils.quotas import ServiceBusyError
 from .persistence import DomainInfo, EntityNotExistsError, Stores
+from .task_refresher import refresh_tasks as _refresh
 from .shard import ShardContext
 
 
@@ -360,7 +362,8 @@ class HistoryEngine:
                        initiator: Optional[ContinueAsNewInitiator] = None,
                        attempt: int = 0,
                        expiration_timestamp: int = 0,
-                       initial_signals: Sequence[str] = ()) -> str:
+                       initial_signals: Sequence[Union[str, Tuple[str, Optional[str]]]]
+                       = ()) -> str:
         self.metrics.inc(m.SCOPE_HISTORY_START_WORKFLOW, m.M_REQUESTS)
         run_id = run_id or str(uuid.uuid4())
         # duplicate check BEFORE any write (the create fence still guards
@@ -414,12 +417,21 @@ class HistoryEngine:
         # SignalWithStart: the signal events land in the START transaction,
         # before the first decision schedule (historyEngine.go
         # SignalWithStartWorkflowExecution orders started→signaled→decision)
-        for signal_name in initial_signals:
+        for sig in initial_signals:
+            # (name, request_id) pairs ride the dedup set from birth: a
+            # SignalWithStart retried after the start committed must
+            # no-op its signal arm, not double-deliver (plain names stay
+            # accepted for callers without a request id)
+            sig_name, sig_rid = (sig if isinstance(sig, tuple)
+                                 else (sig, None))
+            sig_attrs: Dict[str, Any] = dict(signal_name=sig_name)
+            if sig_rid:
+                sig_attrs["request_id"] = sig_rid
             events.append(HistoryEvent(
                 id=len(events) + 1,
                 event_type=EventType.WorkflowExecutionSignaled,
                 version=version, timestamp=now,
-                attrs=dict(signal_name=signal_name)))
+                attrs=sig_attrs))
         # generateFirstDecisionTask (historyEngine.go:529) unless delayed
         if first_decision_backoff <= 0:
             events.append(HistoryEvent(
@@ -436,7 +448,6 @@ class HistoryEngine:
         sb.apply_batch(batch)
         # the start batch counts toward history size like every later
         # transaction's; the bytes double as the WAL record's blob
-        from ..core.codec import serialize_history
         start_blob = serialize_history([batch])
         ms.history_size = len(start_blob)
 
@@ -1069,8 +1080,13 @@ class HistoryEngine:
                                                         workflow_id, run_id)
                 if ms.execution_info.state != WorkflowState.Completed:
                     try:
+                        # the request id dedups the SIGNAL arm too
+                        # (SignalWithStartWorkflowExecutionRequest.
+                        # RequestId): a client retry after a crash must
+                        # not double-apply the signal
                         self.signal_workflow(domain_id, workflow_id,
-                                             signal_name, run_id)
+                                             signal_name, run_id,
+                                             request_id=request_id)
                         return run_id
                     except (EntityNotExistsError, ConditionFailedError):
                         # closed (or raced) between read and commit:
@@ -1085,7 +1101,8 @@ class HistoryEngine:
                     execution_timeout=execution_timeout,
                     decision_timeout=decision_timeout,
                     cron_schedule=cron_schedule, retry_policy=retry_policy,
-                    request_id=request_id, initial_signals=(signal_name,))
+                    request_id=request_id,
+                    initial_signals=((signal_name, request_id),))
             except WorkflowAlreadyStartedError:
                 continue  # lost the create race: retry as a signal
         raise InvalidRequestError(
@@ -1200,7 +1217,6 @@ class HistoryEngine:
         # timers forked into the prefix, the workflow-timeout timer, the
         # transient decision — exactly the state-rebuild case the task
         # refresher exists for (mutable_state_task_refresher.go:77)
-        from .task_refresher import refresh_tasks as _refresh
         new_ms.transfer_tasks, new_ms.timer_tasks = [], []
         new_ms.cross_cluster_tasks = []
         events_by_id = {e.id: e for pb in prefix for e in pb.events}
@@ -1507,7 +1523,6 @@ class HistoryEngine:
         them into this shard's queues. Called on standby promotion (the
         workflow changed hands and its task rows live on the old active
         cluster) and by admin refresh. Returns the number of tasks created."""
-        from .task_refresher import refresh_tasks as _refresh
         ms, expected = self._load(domain_id, workflow_id, run_id)
         run_id = ms.execution_info.run_id
         events = self.stores.history.read_events(domain_id, workflow_id, run_id)
@@ -1666,7 +1681,6 @@ class _Txn:
         # codec-serialized batch is what the store pays for this commit;
         # the SAME bytes become the WAL record's blob below — one
         # serialize_history per transaction, not two
-        from ..core.codec import serialize_history
         events_blob = serialize_history([batch])
         self.ms.history_size += len(events_blob)
         new_transfer = list(self.ms.transfer_tasks)

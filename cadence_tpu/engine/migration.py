@@ -292,8 +292,16 @@ class MigrationManager:
         stores = self.tpu.stores
         resident = self.tpu.resident
         try:
-            keys = [k for k in stores.execution.list_executions()
-                    if self.shard_of(k) in wanted]
+            # O(stolen keys): the store's per-shard execution index,
+            # maintained incrementally by every writer — never a full
+            # list_executions walk per steal (wire stores proxy the
+            # method generically; pre-index servers fall back)
+            try:
+                keys = stores.execution.list_executions_for_shards(
+                    sorted(wanted), self.num_shards)
+            except AttributeError:
+                keys = [k for k in stores.execution.list_executions()
+                        if self.shard_of(k) in wanted]
         except Exception:
             return report
         #: (key, entry, token) suffix items + their stability anchors

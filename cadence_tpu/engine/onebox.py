@@ -126,7 +126,8 @@ class Onebox:
                        cm.M_VIS_FALLBACK_COLUMN, cm.M_VIS_PARITY_CHECKS,
                        cm.M_VIS_DIVERGENCE, cm.M_VIS_DELTAS,
                        cm.M_VIS_DRAINS, cm.M_VIS_TOPK, cm.M_VIS_BITMAP,
-                       cm.M_VIS_TOPK_ESCALATIONS):
+                       cm.M_VIS_TOPK_ESCALATIONS,
+                       cm.M_VIS_ATTR_REPLACEMENTS):
             self.metrics.inc(cm.SCOPE_TPU_VISIBILITY, metric, 0)
         self.metrics.gauge(cm.SCOPE_TPU_VISIBILITY, cm.M_VIS_STALENESS,
                            0.0)

@@ -453,6 +453,12 @@ class ExecutionStore:
         #: update, passive upsert, create, delete) — the execution cache's
         #: revalidation token (execution/cache.go staleness guard)
         self._versions: Dict[Tuple[str, str, str], int] = {}
+        #: per-shard execution index: num_shards -> shard -> key set.
+        #: Built lazily on the first `list_executions_for_shards` call for
+        #: a given shard space, then maintained incrementally by every
+        #: writer — a shard steal's hydration reads O(stolen keys), never
+        #: O(all executions) (migration.MigrationManager's access pattern)
+        self._shard_index: Dict[int, Dict[int, set]] = {}
 
     def _check_fence(self, shard_id: int, range_id: int) -> None:
         cur = self._shard_store.get_or_create(shard_id)
@@ -478,6 +484,7 @@ class ExecutionStore:
                 )
             self._executions[key] = ms
             self._versions[key] = self._versions.get(key, 0) + 1
+            self._shard_index_add_locked(key)
             self._current[cur_key] = CurrentExecution(
                 run_id=info.run_id, state=info.state, close_status=info.close_status
             )
@@ -545,6 +552,7 @@ class ExecutionStore:
             key = (info.domain_id, info.workflow_id, info.run_id)
             self._executions[key] = ms
             self._versions[key] = self._versions.get(key, 0) + 1
+            self._shard_index_add_locked(key)
             if set_current:
                 self._current[(info.domain_id, info.workflow_id)] = CurrentExecution(
                     run_id=info.run_id, state=info.state,
@@ -600,6 +608,7 @@ class ExecutionStore:
             existed = self._executions.pop(key, None) is not None
             if existed:
                 self._versions[key] = self._versions.get(key, 0) + 1
+                self._shard_index_drop_locked(key)
             cur = self._current.get((domain_id, workflow_id))
             if (cur is not None and cur.run_id == run_id
                     and cur.state == WorkflowState.Completed):
@@ -616,6 +625,42 @@ class ExecutionStore:
     def list_executions(self) -> List[Tuple[str, str, str]]:
         with self._lock:
             return list(self._executions.keys())
+
+    # -- per-shard execution index -----------------------------------------
+
+    def _shard_index_add_locked(self, key: Tuple[str, str, str]) -> None:
+        from .membership import shard_id_for_workflow
+        for num_shards, buckets in self._shard_index.items():
+            buckets.setdefault(
+                shard_id_for_workflow(key[1], num_shards), set()).add(key)
+
+    def _shard_index_drop_locked(self, key: Tuple[str, str, str]) -> None:
+        from .membership import shard_id_for_workflow
+        for num_shards, buckets in self._shard_index.items():
+            buckets.get(shard_id_for_workflow(key[1], num_shards),
+                        set()).discard(key)
+
+    def list_executions_for_shards(self, shard_ids, num_shards: int
+                                   ) -> List[Tuple[str, str, str]]:
+        """Keys living in `shard_ids` of a `num_shards` shard space
+        (membership.shard_id_for_workflow). The first call for a shard
+        space pays one full scan to build its index; every later call —
+        the migration hydration path — reads only the requested buckets,
+        O(stolen keys). Sorted, so hydration order is deterministic."""
+        from .membership import shard_id_for_workflow
+        with self._lock:
+            buckets = self._shard_index.get(int(num_shards))
+            if buckets is None:
+                buckets = {}
+                for key in self._executions:
+                    buckets.setdefault(
+                        shard_id_for_workflow(key[1], num_shards),
+                        set()).add(key)
+                self._shard_index[int(num_shards)] = buckets
+            out: List[Tuple[str, str, str]] = []
+            for s in shard_ids:
+                out.extend(buckets.get(int(s), ()))
+            return sorted(out)
 
 
 # ---------------------------------------------------------------------------

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, List, Optional
 
 from ..core.enums import (
+    EMPTY_EVENT_ID,
     CloseStatus,
     EventType,
     TimerTaskType,
@@ -26,7 +27,11 @@ from ..utils.clock import TimeSource
 from ..utils.metrics import SCOPE_QUEUE_TIMER, SCOPE_QUEUE_TRANSFER
 from .history_engine import InvalidRequestError
 from .matching import MatchingEngine
-from .persistence import EntityNotExistsError, Stores
+from .persistence import (
+    EntityNotExistsError,
+    Stores,
+    WorkflowAlreadyStartedError,
+)
 
 if TYPE_CHECKING:
     from .controller import ShardController
@@ -498,7 +503,6 @@ class QueueProcessors:
         ci = ms.pending_child_execution_info_ids.get(task.event_id)
         if ci is None:
             return  # already resolved
-        from ..core.enums import EMPTY_EVENT_ID
         if ci.started_id != EMPTY_EVENT_ID:
             return  # redelivered task; child already started (idempotency)
         parent_info = ms.execution_info
@@ -509,28 +513,66 @@ class QueueProcessors:
                     KIND_START_CHILD, domain_id, workflow_id, run_id,
                     task.event_id, child_domain, ci.started_workflow_id,
                     workflow_type=ci.workflow_type_name,
-                    task_list=parent_info.task_list,
+                    task_list=ci.task_list or parent_info.task_list,
                     execution_timeout=parent_info.workflow_timeout,
                     decision_timeout=parent_info.decision_start_to_close_timeout,
                     parent_initiated_id=ci.initiated_id,
                     create_request_id=ci.create_request_id):
                 return
-        child_engine = self.router(ci.started_workflow_id)
-        child_run_id = child_engine.start_workflow(
-            domain_id=ci.domain_id or domain_id,
-            workflow_id=ci.started_workflow_id,
-            workflow_type=ci.workflow_type_name,
-            task_list=parent_info.task_list,
-            execution_timeout=parent_info.workflow_timeout,
-            decision_timeout=parent_info.decision_start_to_close_timeout,
-            parent=dict(
-                parent_workflow_domain_id=domain_id,
-                parent_workflow_id=workflow_id,
-                parent_run_id=run_id,
-                parent_initiated_event_id=ci.initiated_id,
-            ),
-            request_id=ci.create_request_id,
-        )
+        # redelivery-first probe: a fault between the child create and
+        # the parent's started record leaves an existing run THIS
+        # INITIATION made — adopt it whether it is still open or already
+        # COMPLETED (a completed child must not be restarted as a
+        # duplicate). The adoption key is the full parent linkage
+        # (parent run + initiated event id) PLUS the create request id:
+        # request ids alone are derived per event id (batch_request_id)
+        # and repeat across a parent's continue-as-new/reset run chain,
+        # so a later run re-initiating the same child id at a colliding
+        # event id must start FRESH, never adopt the previous run's
+        # child.
+        child_run_id = None
+        try:
+            existing = self.stores.execution.get_current_run_id(
+                child_domain, ci.started_workflow_id)
+            child_info = self.stores.execution.get_workflow(
+                child_domain, ci.started_workflow_id,
+                existing).execution_info
+            if (child_info.create_request_id == ci.create_request_id
+                    and child_info.parent_run_id == run_id
+                    and child_info.initiated_id == ci.initiated_id):
+                child_run_id = existing
+        except EntityNotExistsError:
+            pass
+        if child_run_id is None:
+            child_engine = self.router(ci.started_workflow_id)
+            try:
+                child_run_id = child_engine.start_workflow(
+                    domain_id=ci.domain_id or domain_id,
+                    workflow_id=ci.started_workflow_id,
+                    workflow_type=ci.workflow_type_name,
+                    # the initiated event's task list wins; inheriting
+                    # the parent's is the no-attribute fallback
+                    task_list=ci.task_list or parent_info.task_list,
+                    execution_timeout=parent_info.workflow_timeout,
+                    decision_timeout=parent_info.decision_start_to_close_timeout,
+                    parent=dict(
+                        parent_workflow_domain_id=domain_id,
+                        parent_workflow_id=workflow_id,
+                        parent_run_id=run_id,
+                        parent_initiated_event_id=ci.initiated_id,
+                    ),
+                    request_id=ci.create_request_id,
+                )
+            except WorkflowAlreadyStartedError:
+                # a FOREIGN workflow squatting on the child's id: record
+                # the start failure on the parent (the reference's
+                # WorkflowAlreadyStarted child-start outcome) so the
+                # pending child resolves instead of wedging the parent
+                # until its execution timeout
+                engine.on_child_start_failed(
+                    domain_id, workflow_id, run_id, ci.initiated_id,
+                    cause="WORKFLOW_ALREADY_RUNNING")
+                return
         engine.on_child_started(domain_id, workflow_id, run_id,
                                 ci.initiated_id, child_run_id)
 
@@ -661,7 +703,6 @@ class QueueProcessors:
         """executeActivityRetryTimerTask (timer_active_task_executor.go):
         the backoff elapsed — re-dispatch the pending attempt straight to
         matching; no history event is written for a retry dispatch."""
-        from ..core.enums import EMPTY_EVENT_ID
         ms = self.stores.execution.get_workflow(domain_id, workflow_id, run_id)
         ai = ms.pending_activity_info_ids.get(task.event_id)
         if (ai is None or ai.started_id != EMPTY_EVENT_ID

@@ -192,6 +192,12 @@ class DeviceVisibilityView:
         self._valid = np.zeros(self.capacity, dtype=bool)
         self._attr_cols: Dict[str, _AttrCol] = {}
         self._overflow_attrs: set = set()
+        #: LFU bookkeeping: per-column query references (retention
+        #: value) and per-OVERFLOW-attr fallback-causing references
+        #: (admission demand) — when an overflow attr out-demands the
+        #: least-used column, they swap (see _maybe_replace_attr)
+        self._attr_use: Dict[str, int] = {}
+        self._attr_demand: Dict[str, int] = {}
         self._intern: Dict[str, int] = {}
         self._intern_rev: List[str] = []
 
@@ -342,6 +348,30 @@ class DeviceVisibilityView:
             self._need_restage = True
         return ac
 
+    @staticmethod
+    def _classify_attr(value):
+        """(kind, normalized value) for one search-attr value — the ONE
+        typing lattice the delta-apply path and the LFU backfill share
+        (None kind = unrepresentable: poisons the column)."""
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        if isinstance(value, bool):
+            # Python bool IS int (True == 1): store numerically so
+            # device comparisons reproduce the host lattice
+            return "f64", float(value)
+        if isinstance(value, (int, float)):
+            if isinstance(value, int) and abs(value) > _F64_EXACT:
+                return None, 0.0  # unrepresentable exactly in float64
+            if isinstance(value, float) and value != value:
+                # a NaN VALUE would alias the column's null sentinel
+                # (host: nan != 3 matches; device: the presence guard
+                # would exclude the row)
+                return None, 0.0
+            return "f64", float(value)
+        if isinstance(value, str):
+            return "id", value
+        return None, 0.0  # non-scalar: host semantics only
+
     def _apply_upsert(self, delta) -> None:
         _seq, _kind, key, wf_type, status, start, close, attrs = delta
         row = self._key_to_row.get(key)
@@ -369,25 +399,7 @@ class DeviceVisibilityView:
         for ac in self._attr_cols.values():
             ac.data[row] = -1 if ac.kind == "id" else np.nan
         for name, value in attrs.items():
-            if isinstance(value, bytes):
-                value = value.decode("utf-8", "replace")
-            if isinstance(value, bool):
-                # Python bool IS int (True == 1): store numerically so
-                # device comparisons reproduce the host lattice
-                kind, num = "f64", float(value)
-            elif isinstance(value, (int, float)):
-                kind, num = "f64", float(value)
-                if isinstance(value, int) and abs(value) > _F64_EXACT:
-                    kind = None  # unrepresentable exactly: poison
-                elif isinstance(value, float) and value != value:
-                    # a NaN VALUE would alias the column's null
-                    # sentinel (host: nan != 3 matches; device: the
-                    # presence guard would exclude the row) — poison
-                    kind = None
-            elif isinstance(value, str):
-                kind, num = "id", 0.0
-            else:
-                kind = None  # non-scalar: host semantics only
+            kind, norm = self._classify_attr(value)
             ac = self._attr_col(name, kind or "f64")
             if ac is None:
                 continue
@@ -396,8 +408,8 @@ class DeviceVisibilityView:
                 continue
             if ac.poisoned:
                 continue
-            ac.data[row] = (self._intern_id(value) if kind == "id"
-                            else num)
+            ac.data[row] = (self._intern_id(norm) if kind == "id"
+                            else norm)
         self._changed_rows.add(row)
 
     def _apply_delete(self, key) -> None:
@@ -499,6 +511,9 @@ class DeviceVisibilityView:
             return (scan.COL_I64, code, name, p, 0.0)
         # custom search attribute (case-sensitive, like the host)
         if field in self._overflow_attrs:
+            with self._lock:
+                self._attr_demand[field] = \
+                    self._attr_demand.get(field, 0) + 1
             raise scan.UnsupportedPredicate(
                 f"attr {field!r} past the column budget", reason="column")
         ac = self._attr_cols.get(field)
@@ -508,6 +523,8 @@ class DeviceVisibilityView:
         if ac.poisoned:
             raise scan.UnsupportedPredicate(
                 f"attr {field!r} mixed-type", reason="column")
+        with self._lock:
+            self._attr_use[field] = self._attr_use.get(field, 0) + 1
         if ac.kind == "id":
             return self._id_leaf(f"attr:{field}", op, value, attr=ac)
         # numeric column
@@ -575,7 +592,83 @@ class DeviceVisibilityView:
         else:
             self.served_staleness_max = max(self.served_staleness_max,
                                             backlog)
+        self._maybe_replace_attr(store)
         return True
+
+    def _maybe_replace_attr(self, store) -> None:
+        """LFU attr-column replacement: when an over-budget attribute
+        out-demands the least-queried resident column, they swap — the
+        evicted column joins the overflow set (its use count becomes its
+        comeback demand), the promoted attr backfills from the store's
+        records under the caller-held STORE lock, and queries that used
+        to permanently fall back start serving from the device. Counted
+        under tpu.visibility/attr-column-replacements."""
+        with self._lock:
+            if not self._attr_demand:
+                return
+            cand = max(self._attr_demand, key=self._attr_demand.get)
+            demand = self._attr_demand[cand]
+            if demand <= 0:
+                return
+            if len(self._attr_cols) >= self.attr_budget:
+                # poisoned columns serve nothing: evict them first
+                lfu = min(self._attr_cols,
+                          key=lambda n: (not self._attr_cols[n].poisoned,
+                                         self._attr_use.get(n, 0)))
+                floor = (0 if self._attr_cols[lfu].poisoned
+                         else self._attr_use.get(lfu, 0))
+                # hysteresis: a swap pays a full backfill + restage +
+                # kernel recompile, so the challenger must CLEARLY
+                # out-demand the resident (2x), or a budget+1 steady mix
+                # would thrash a swap every couple of queries — worse
+                # than the host fallback it replaces
+                if demand <= 2 * floor:
+                    return
+                del self._attr_cols[lfu]
+                self._overflow_attrs.add(lfu)
+                # decay the evicted column's comeback demand: carrying
+                # the full historical count over would leave the two
+                # counters near-tied forever (perpetual oscillation)
+                self._attr_demand[lfu] = self._attr_use.pop(lfu, 0) // 2
+            self._overflow_attrs.discard(cand)
+            self._attr_use[cand] = self._attr_demand.pop(cand)
+            # apply the pending delta backlog FIRST: the backfill reads
+            # store-current records, and mixing them into a lagging
+            # column snapshot (staleness bound > 0) would stage a row
+            # state no store snapshot ever held
+            self._drain_locked()
+            self._backfill_attr_locked(store, cand)
+            self._need_restage = True
+            # restage NOW: the very query that triggered the swap will
+            # compile against the promoted column, and the serve path
+            # only drains when the staleness bound forces it
+            self._sync_device_locked()
+            self.metrics.inc(m.SCOPE_TPU_VISIBILITY,
+                             m.M_VIS_ATTR_REPLACEMENTS)
+
+    def _backfill_attr_locked(self, store, name: str) -> None:
+        """Admit `name` as a column populated from the records already
+        staged (a late admit must see exactly the values an admit at
+        first write would have) — held under self._lock, with the STORE
+        lock held by the query entry point above us."""
+        col = None
+        for key, row in self._key_to_row.items():
+            if not self._valid[row]:
+                continue
+            rec = store._records.get(key)
+            if rec is None or name not in rec.search_attrs:
+                continue
+            kind, norm = self._classify_attr(rec.search_attrs[name])
+            if col is None:
+                col = _AttrCol(name, kind or "f64", self.capacity)
+            if kind is None or (col.kind != kind and not col.poisoned):
+                col.poisoned = True
+                continue
+            if not col.poisoned:
+                col.data[row] = (self._intern_id(norm) if kind == "id"
+                                 else norm)
+        self._attr_cols[name] = (col if col is not None
+                                 else _AttrCol(name, "f64", self.capacity))
 
     def _consistent(self, store) -> bool:
         """True when the device view equals the store right now — the
@@ -867,6 +960,8 @@ class DeviceVisibilityView:
                 "rows": self._rows, "capacity": self.capacity,
                 "attr_columns": len(self._attr_cols),
                 "attr_overflow": overflow, "attr_poisoned": poisoned,
+                "attr_use": dict(self._attr_use),
+                "attr_overflow_demand": dict(self._attr_demand),
                 "interned_strings": len(self._intern_rev),
                 "pending_deltas": pending,
                 "applied_seq": self._applied_seq,

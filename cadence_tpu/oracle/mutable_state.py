@@ -231,6 +231,10 @@ class ChildExecutionInfo:
     domain_id: str = ""
     workflow_type_name: str = ""
     parent_close_policy: int = 0
+    #: the StartChildWorkflowExecution decision's task list (empty =
+    #: inherit the parent's, the pre-attr behavior); host-side only —
+    #: never part of the canonical payload
+    task_list: str = ""
 
 
 @dataclass(slots=True)

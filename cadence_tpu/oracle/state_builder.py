@@ -573,6 +573,7 @@ class StateBuilder:
             domain_id=event.get("domain_id") or ms.execution_info.domain_id,
             workflow_type_name=event.get("workflow_type", ""),
             parent_close_policy=event.get("parent_close_policy", 0) or 0,
+            task_list=event.get("task_list", "") or "",
         )
         ms.pending_child_execution_info_ids[ci.initiated_id] = ci
         return ci

@@ -276,6 +276,10 @@ M_VIS_ROWS = "rows"
 M_VIS_ATTR_COLUMNS = "attr-columns"
 M_VIS_INTERNED = "interned-strings"
 M_VIS_SCAN_LATENCY = "scan-latency"
+#: LFU attr-column swaps: an over-budget search attribute out-demanded
+#: the least-queried resident column and took its slot — queries on it
+#: stop permanently falling back (visibility_device._maybe_replace_attr)
+M_VIS_ATTR_REPLACEMENTS = "attr-column-replacements"
 
 
 def ladder_rung_rows(rung: int) -> str:

@@ -24,6 +24,7 @@ from cadence_tpu.engine.migration import InReport, MigrationManager
 from cadence_tpu.engine.persistence import Stores
 from cadence_tpu.engine.tpu_engine import TPUReplayEngine
 from cadence_tpu.gen.corpus import generate_corpus
+from cadence_tpu.oracle.mutable_state import MutableState
 from cadence_tpu.oracle.state_builder import StateBuilder
 from cadence_tpu.parallel.mesh import workflow_shard
 from cadence_tpu.utils import metrics as m
@@ -384,3 +385,88 @@ class TestControllerHooks:
         assert not fresh.shard.is_closed
         assert fresh.shard.range_id > old_range
         fresh.signal_workflow("mig-d", wf, "post-flap")  # serves again
+
+
+class TestShardExecutionIndex:
+    """ISSUE 15 satellite: migration hydration is O(stolen keys) via the
+    store's per-shard execution index — never a `list_executions` walk
+    per steal."""
+
+    def _seed(self, n=24, num_shards=8):
+        from cadence_tpu.engine.membership import shard_id_for_workflow
+        stores = Stores()
+        expected = {}
+        for i in range(n):
+            wf = f"idx-wf-{i}"
+            ms = MutableState()
+            ms.execution_info.domain_id = "idx-d"
+            ms.execution_info.workflow_id = wf
+            ms.execution_info.run_id = f"r-{i}"
+            stores.execution.upsert_workflow(ms)
+            expected.setdefault(
+                shard_id_for_workflow(wf, num_shards), set()).add(
+                    ("idx-d", wf, f"r-{i}"))
+        return stores, expected
+
+    def test_index_matches_filter_and_stays_incremental(self):
+        stores, expected = self._seed()
+        for shard, keys in expected.items():
+            got = stores.execution.list_executions_for_shards([shard], 8)
+            assert set(got) == keys
+            assert got == sorted(got)
+        # incremental maintenance: writes and deletes after the build
+        from cadence_tpu.engine.membership import shard_id_for_workflow
+        ms = MutableState()
+        ms.execution_info.domain_id = "idx-d"
+        ms.execution_info.workflow_id = "idx-new"
+        ms.execution_info.run_id = "r-new"
+        stores.execution.upsert_workflow(ms)
+        s = shard_id_for_workflow("idx-new", 8)
+        assert ("idx-d", "idx-new", "r-new") in \
+            stores.execution.list_executions_for_shards([s], 8)
+        victim = next(iter(expected[s])) if expected.get(s) else None
+        if victim is not None:
+            stores.execution.delete_workflow(*victim)
+            assert victim not in \
+                stores.execution.list_executions_for_shards([s], 8)
+
+    def test_access_pattern_pinned_no_full_walk_after_build(self):
+        """The regression pin: once a shard space's index is built,
+        reads never touch the full execution table again — a steal's
+        hydration cost is the stolen buckets, not the fleet."""
+        stores, expected = self._seed()
+        stores.execution.list_executions_for_shards([0], 8)  # build
+
+        class _Boom(dict):
+            def keys(self):
+                raise AssertionError("full-table walk after index build")
+            def __iter__(self):
+                raise AssertionError("full-table walk after index build")
+
+        real = stores.execution._executions
+        stores.execution._executions = _Boom(real)
+        try:
+            for shard in range(8):
+                got = stores.execution.list_executions_for_shards([shard], 8)
+                assert set(got) == expected.get(shard, set())
+        finally:
+            stores.execution._executions = real
+
+    def test_migration_hydration_uses_the_index(self, monkeypatch):
+        """MigrationManager.hydrate_shards must read through the index
+        path, not list_executions (pre-index stores keep the fallback)."""
+        from cadence_tpu.engine.migration import MigrationManager
+        from cadence_tpu.engine.tpu_engine import TPUReplayEngine
+
+        stores, expected = self._seed(n=6, num_shards=4)
+        tpu = TPUReplayEngine(stores, chunk_workflows=8)
+        mgr = MigrationManager("h-idx", 4, tpu)
+
+        def boom():
+            raise AssertionError("hydration walked list_executions")
+
+        monkeypatch.setattr(stores.execution, "list_executions", boom,
+                            raising=False)
+        report = mgr.hydrate_shards([0, 1])
+        want = len(expected.get(0, ())) + len(expected.get(1, ()))
+        assert report.considered == want

@@ -291,6 +291,53 @@ class TestIncrementalAppends:
         assert reg.counter(m.SCOPE_TPU_VISIBILITY, m.M_VIS_DIVERGENCE) == 0
         store._device.stop()
 
+    def test_attr_budget_lfu_replacement_stops_permanent_fallback(
+            self, vis_env, monkeypatch):
+        """ISSUE 15 satellite: a repeatedly-queried over-budget attr
+        out-demands the least-queried column and takes its slot — the
+        fallback is transient, not permanent. The swap is counted under
+        tpu.visibility/attr-column-replacements, the promoted column
+        backfills the values already staged, and parity stays clean
+        (the evicted column now falls back instead)."""
+        monkeypatch.setenv("CADENCE_TPU_VISIBILITY_ATTR_COLUMNS", "2")
+        store = VisibilityStore()
+        for i in range(6):
+            store.record_started(VisibilityRecord(
+                DOMAIN, f"wf-{i}", f"r-{i}", "t", i,
+                search_attrs={"A": i, "B": i * 2, "C": f"c{i}"}))
+        reg = m.DEFAULT_REGISTRY
+        # A earns use; B never queried; C (overflowed) accrues demand
+        assert store.count(DOMAIN, "A >= 3") == 3
+        assert {r.workflow_id for r in store.query(DOMAIN, "C = 'c2'")} \
+            == {"wf-2"}  # fallback #1: demand C=1 > use B=0
+        pre_swaps = reg.counter(m.SCOPE_TPU_VISIBILITY,
+                                m.M_VIS_ATTR_REPLACEMENTS)
+        pre_fb = reg.counter(m.SCOPE_TPU_VISIBILITY,
+                             m.M_VIS_FALLBACK_COLUMN)
+        # the next query triggers the swap (B evicted, C admitted with
+        # backfill) and serves from the DEVICE
+        assert {r.workflow_id for r in store.query(DOMAIN, "C = 'c4'")} \
+            == {"wf-4"}
+        assert reg.counter(m.SCOPE_TPU_VISIBILITY,
+                           m.M_VIS_ATTR_REPLACEMENTS) == pre_swaps + 1
+        assert reg.counter(m.SCOPE_TPU_VISIBILITY,
+                           m.M_VIS_FALLBACK_COLUMN) == pre_fb
+        view = store._device
+        assert set(view._attr_cols) == {"A", "C"}
+        assert "B" in view._overflow_attrs
+        # the evicted column's use became its comeback demand, and
+        # LATE WRITES to the promoted column keep applying
+        store.record_started(VisibilityRecord(
+            DOMAIN, "wf-9", "r-9", "t", 9, search_attrs={"C": "c9"}))
+        assert {r.workflow_id for r in store.query(DOMAIN, "C = 'c9'")} \
+            == {"wf-9"}
+        # B now falls back (transiently, until it out-demands someone)
+        assert {r.workflow_id for r in store.query(DOMAIN, "B = 4")} \
+            == {"wf-2"}
+        assert reg.counter(m.SCOPE_TPU_VISIBILITY, m.M_VIS_DIVERGENCE) == 0
+        assert view.stats()["attr_overflow_demand"].get("B", 0) >= 1
+        view.stop()
+
 
 class TestStaleness:
     def test_bound_zero_flushes_before_serving(self, vis_env):
