@@ -200,6 +200,29 @@ def main(argv=None) -> int:
                           "--host first: persist a snapshot record for "
                           "each resident row, so a following kill or "
                           "rebalance is a warm failover")
+    top = adm.add_parser("top")
+    top.add_argument("--http", action="append", default=[],
+                     metavar="[NAME=]HOST:PORT",
+                     help="live host /timeseries endpoint to scrape "
+                          "(repeatable; fleet utilization, binding "
+                          "resource, burn rates — skips the WAL when "
+                          "given)")
+    hp = adm.add_parser("hostprof")
+    hp.add_argument("--host", default="", metavar="HOST:PORT",
+                    help="live service host to profile over the wire "
+                         "(admin_hostprof op; skips the WAL)")
+    hp.add_argument("--duration", type=float, default=0.5,
+                    help="burst-sample window in seconds when the "
+                         "target's profiler thread is not running")
+    fr = adm.add_parser("flightrec")
+    fr.add_argument("--host", default="", metavar="HOST:PORT",
+                    help="live service host to query over the wire "
+                         "(admin_flightrec op; skips the WAL)")
+    fr.add_argument("--last", type=int, default=100,
+                    help="trailing events to include")
+    fr.add_argument("--dump", default="",
+                    help="also dump the full ring to this JSONL path "
+                         "(on the TARGET host in wire mode)")
     snp = adm.add_parser("snapshot")
     snp.add_argument("--sweep", action="store_true",
                      help="run one verify pass (seeding the resident "
@@ -378,6 +401,12 @@ def main(argv=None) -> int:
     if args.group == "admin" and args.cmd == "cluster" and args.host:
         # wire mode: roll up live hosts without opening any WAL
         return _cluster_tool(args)
+    if args.group == "admin" and args.cmd == "top" and args.http:
+        # fleet telemetry rollup over /timeseries scrapes: no WAL either
+        return _top_tool(args)
+    if args.group == "admin" and args.cmd in ("hostprof", "flightrec") \
+            and args.host:
+        return _telemetry_tool(args)
     if not args.wal:
         parser.error(f"--wal is required for the {args.group} group")
     if args.group == "wal":
@@ -674,6 +703,19 @@ def main(argv=None) -> int:
                                 "skipped_checksum":
                                 sweep.skipped_checksum}
             _emit({**out, **admin.snapshot()})
+        elif args.cmd == "top":
+            # in-process arm: the box's sampler folds one more window
+            # (build → now) and the summary renders from it
+            _emit(admin.top())
+        elif args.cmd == "hostprof":
+            # in-process arm: burst-sample THIS process for --duration
+            # and report the subsystem attribution + GIL estimate
+            _emit(admin.hostprof(duration_s=args.duration))
+        elif args.cmd == "flightrec":
+            # in-process arm: whatever the box's workload emitted into
+            # the process-global ring (CLI batch ops, fsck, breakers)
+            _emit(admin.flightrec(last_n=args.last,
+                                  dump=args.dump or None))
         elif args.cmd == "failover":
             # flip the domain active to --to on THIS cluster's metadata
             # and regenerate the promoted side's tasks (the CLI arm of
@@ -728,6 +770,46 @@ def _cluster_tool(args) -> int:
             rc = 1
     _emit(doc)
     return rc
+
+
+def _top_tool(args) -> int:
+    """`admin top --http [NAME=]H:P [--http ...]` — the fleet arm: scrape
+    every named host's /timeseries, summarize (utilization, binding
+    resource, burn rates), aggregate cluster-wide. Exit 1 iff any host
+    failed to scrape."""
+    from .engine.admin import fleet_top
+
+    endpoints = {}
+    for spec in args.http:
+        name, _, endpoint = spec.rpartition("=")
+        endpoints[name or endpoint] = endpoint
+    doc = fleet_top(endpoints)
+    _emit(doc)
+    return 1 if any("error" in s for s in doc["hosts"].values()) else 0
+
+
+def _telemetry_tool(args) -> int:
+    """`admin hostprof --host H:P` / `admin flightrec --host H:P` — the
+    wire arms over the admin_hostprof / admin_flightrec ops."""
+    from .rpc.wire import call as wire_call
+
+    h, p = args.host.rsplit(":", 1)
+    address = (h, int(p))
+    try:
+        if args.cmd == "hostprof":
+            doc = wire_call(address, ("admin_hostprof", args.duration),
+                            timeout=30)
+        else:
+            doc = wire_call(address,
+                            ("admin_flightrec", args.last,
+                             args.dump or None),
+                            timeout=30)
+    except Exception as exc:
+        _emit({"host": args.host,
+               "error": f"{type(exc).__name__}: {exc}"})
+        return 1
+    _emit(doc)
+    return 0
 
 
 def _fuzz_tool(args) -> int:

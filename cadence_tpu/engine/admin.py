@@ -7,9 +7,21 @@ CLI consumes (tools/cli admin commands).
 """
 from __future__ import annotations
 
+import json
+import time
+import urllib.request
+from collections import Counter
 from typing import Any, Dict, List, Optional
 
 from ..core.checksum import Checksum
+from ..utils import flightrecorder
+from ..utils import metrics as m
+from . import migration as migration_mod
+from . import resident as resident_mod
+from . import snapshot as snapshot_mod
+from . import visibility_device as vd
+from .authorization import (PERMISSION_ADMIN, AuthAttributes, NoopAuthorizer,
+                            check)
 from .persistence import EntityNotExistsError
 
 
@@ -22,7 +34,6 @@ class AdminHandler:
     surface — VERDICT r3 ask #9."""
 
     def __init__(self, box, authorizer=None, actor: str = "") -> None:
-        from .authorization import NoopAuthorizer
         self.box = box
         self.authorizer = (authorizer if authorizer is not None
                            else getattr(box, "authorizer", None)
@@ -30,7 +41,6 @@ class AdminHandler:
         self.actor = actor
 
     def _authorize(self, api: str) -> None:
-        from .authorization import PERMISSION_ADMIN, AuthAttributes, check
         check(self.authorizer, AuthAttributes(api=f"admin.{api}",
                                               permission=PERMISSION_ADMIN,
                                               actor=self.actor))
@@ -185,9 +195,8 @@ class AdminHandler:
         traffic is served incrementally."""
         self._authorize("resident")
         cache = self.box.tpu.resident
-        from .resident import enabled
         return {
-            "enabled": enabled(),
+            "enabled": resident_mod.enabled(),
             **cache.stats(),
             "chunk_workflows": cache.chunk_workflows,
             "ladder_max_rungs": (cache.ladder.max_rungs
@@ -202,8 +211,6 @@ class AdminHandler:
         counters — the operator's view of how warm the next restart
         will be."""
         self._authorize("snapshot")
-        from ..utils import metrics as m
-        from .snapshot import enabled
         store = self.box.stores.snapshot
         hs = self.box.stores.history
         staleness: list = []
@@ -221,7 +228,7 @@ class AdminHandler:
         reg = self.box.metrics
         snapper = self.box.tpu.snapshotter()
         return {
-            "enabled": enabled(),
+            "enabled": snapshot_mod.enabled(),
             **store.stats(),
             "staleness_batches": {
                 "p50": pct(0.5), "p99": pct(0.99),
@@ -248,8 +255,6 @@ class AdminHandler:
         the operator's view of how much List/Scan/Count traffic the
         columnar scan absorbs and how fresh the device view is."""
         self._authorize("visibility")
-        from ..utils import metrics as cm
-        from . import visibility_device as vd
         store = self.box.stores.visibility
         view = store._device
         out: Dict[str, Any] = {"enabled": vd.enabled(),
@@ -260,10 +265,10 @@ class AdminHandler:
         else:
             reg = self.box.metrics
             out.update({
-                "queries": reg.counter(cm.SCOPE_TPU_VISIBILITY,
-                                       cm.M_VIS_QUERIES),
-                "parity_divergence": reg.counter(cm.SCOPE_TPU_VISIBILITY,
-                                                 cm.M_VIS_DIVERGENCE),
+                "queries": reg.counter(m.SCOPE_TPU_VISIBILITY,
+                                       m.M_VIS_QUERIES),
+                "parity_divergence": reg.counter(m.SCOPE_TPU_VISIBILITY,
+                                                 m.M_VIS_DIVERGENCE),
             })
         return out
 
@@ -275,9 +280,8 @@ class AdminHandler:
         byte-parity probe the wire arm (`admin cluster --host H:P`,
         the `admin_cluster` op) exposes."""
         self._authorize("cluster")
-        from ..utils import metrics as cm
         reg = self.box.metrics
-        sc = cm.SCOPE_TPU_MIGRATION
+        sc = m.SCOPE_TPU_MIGRATION
         doc: Dict[str, Any] = {
             "cluster": self.box.cluster_name,
             "num_shards": self.box.num_shards,
@@ -287,18 +291,18 @@ class AdminHandler:
             "resident": self.box.tpu.resident.stats(),
             "snapshots": self.box.stores.snapshot.stats(),
             "migration": {
-                "migrated_out": reg.counter(sc, cm.M_MIG_OUT),
-                "migrated_in": reg.counter(sc, cm.M_MIG_IN),
-                "cold_steals": reg.counter(sc, cm.M_MIG_COLD),
-                "stale_snapshots": reg.counter(sc, cm.M_MIG_STALE),
-                "parity_divergence": reg.counter(sc, cm.M_MIG_DIVERGENCE),
+                "migrated_out": reg.counter(sc, m.M_MIG_OUT),
+                "migrated_in": reg.counter(sc, m.M_MIG_IN),
+                "cold_steals": reg.counter(sc, m.M_MIG_COLD),
+                "stale_snapshots": reg.counter(sc, m.M_MIG_STALE),
+                "parity_divergence": reg.counter(sc, m.M_MIG_DIVERGENCE),
             },
         }
         if detail:
-            from .migration import resident_row_checksums
             doc["resident_rows"] = {
                 "|".join(key): row for key, row in
-                resident_row_checksums(self.box.tpu.resident).items()}
+                migration_mod.resident_row_checksums(
+                    self.box.tpu.resident).items()}
         return doc
 
     def serving(self) -> Dict[str, Any]:
@@ -319,3 +323,158 @@ class AdminHandler:
             "resident_entries": len(self.box.tpu.resident),
             "resident_bytes": self.box.tpu.resident.resident_bytes,
         }
+
+    # -- cluster telemetry plane (`admin top` / hostprof / flightrec) ------
+
+    def timeseries(self, last_n: int = 120) -> Dict[str, Any]:
+        """Ring-buffer windows (`admin top` in-process arm): fold the
+        registry's current cumulative state into one more window (the
+        box's sampler is constructed-but-not-threaded, anchored at box
+        build, so this window spans build→now) and return the doc the
+        /timeseries endpoint serves."""
+        self._authorize("timeseries")
+        sampler = self.box.timeseries
+        sampler.sample_once()
+        return sampler.doc(last_n)
+
+    def hostprof(self, duration_s: float = 0.5) -> Dict[str, Any]:
+        """Host-runtime attribution (`admin hostprof` in-process arm).
+        When the box's profiler thread runs, report what it has; else
+        burst-sample this process for `duration_s` first."""
+        self._authorize("hostprof")
+        profiler = self.box.hostprof
+        if profiler._thread is None or not profiler._thread.is_alive():
+            deadline = time.monotonic() + max(0.0, duration_s)
+            while True:
+                profiler.sample_once()
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(profiler.period_s)
+        return profiler.rollup()
+
+    def flightrec(self, last_n: int = 100,
+                  dump: Optional[str] = None) -> Dict[str, Any]:
+        """Flight-recorder snapshot (`admin flightrec` in-process arm):
+        ring stats + the trailing events, optionally dumping the full
+        ring to a JSONL path on the way out."""
+        self._authorize("flightrec")
+        recorder = flightrecorder.DEFAULT_RECORDER
+        doc: Dict[str, Any] = {"stats": recorder.stats(),
+                               "events": recorder.snapshot(last_n),
+                               "dumped": None}
+        if dump:
+            doc["dumped"] = recorder.dump(dump, reason="admin")
+        return doc
+
+    def top(self) -> Dict[str, Any]:
+        """Single-box `admin top`: the same per-host summary shape
+        fleet_top() builds from scraped /timeseries docs, computed over
+        this box's sampler (host name "onebox")."""
+        self._authorize("top")
+        doc = self.timeseries()
+        summary = summarize_windows(doc)
+        summary["hostprof"] = {
+            "attributed_share": self.box.hostprof.attributed_share(),
+            "gil_contention": self.box.hostprof.gil_contention(),
+        }
+        return {"hosts": {"onebox": summary},
+                "cluster": _cluster_rollup({"onebox": summary})}
+
+
+# ---------------------------------------------------------------------------
+# Fleet rollup over scraped /timeseries endpoints (`admin top` wire arm)
+# ---------------------------------------------------------------------------
+
+def scrape_timeseries(endpoint: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """GET one host's /timeseries doc. `endpoint` is host:port or a full
+    http:// base."""
+    base = endpoint if "://" in endpoint else f"http://{endpoint}"
+    with urllib.request.urlopen(f"{base}/timeseries",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def summarize_windows(doc: Dict[str, Any],
+                      horizon_windows: int = 60) -> Dict[str, Any]:
+    """One host's /timeseries doc → the `admin top` row: mean
+    utilization over the trailing windows, the modal binding resource
+    (most-frequent non-idle leg), summed leg seconds, the latest
+    window's saturation, and the slo/* burn gauges the burn-rate
+    evaluator published into the windows."""
+    windows: List[Dict[str, Any]] = list(doc.get("windows", []))
+    if not windows:
+        return {"windows": 0, "utilization": 0.0,
+                "binding_resource": "idle", "legs": {}, "saturation": {},
+                "burn": {}, "alerting": False}
+    recent = windows[-horizon_windows:]
+    utilization = sum(w.get("utilization", 0.0) for w in recent) / len(recent)
+    modes = Counter(w.get("binding_resource", "idle") for w in recent
+                    if w.get("binding_resource", "idle") != "idle")
+    legs: Dict[str, float] = {}
+    for w in recent:
+        for leg, sec in w.get("legs", {}).items():
+            legs[leg] = legs.get(leg, 0.0) + sec
+    latest = windows[-1]
+    slo_prefix = f"{m.SCOPE_SLO}/"
+    burn = {key[len(slo_prefix):]: value
+            for key, value in latest.get("gauges", {}).items()
+            if key.startswith(slo_prefix)}
+    return {
+        "windows": len(windows),
+        "utilization": round(utilization, 4),
+        "binding_resource": (modes.most_common(1)[0][0] if modes
+                             else "idle"),
+        "legs": {leg: round(sec, 4) for leg, sec in sorted(legs.items())},
+        "saturation": latest.get("saturation", {}),
+        "burn": burn,
+        "alerting": burn.get("alerting", 0.0) > 0.0,
+    }
+
+
+def _cluster_rollup(hosts: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Fleet aggregate + host deltas over per-host summaries: cluster
+    utilization (mean), the fleet-wide binding resource (argmax of the
+    SUMMED leg seconds — one host's kernel-bound hour outweighs five
+    idle peers), and the hot/cold utilization spread that tells an
+    operator WHICH host to look at."""
+    rows = {h: s for h, s in hosts.items() if "error" not in s}
+    if not rows:
+        return {"hosts": 0, "utilization": 0.0, "binding_resource": "idle",
+                "alerting": False}
+    legs: Dict[str, float] = {}
+    for summary in rows.values():
+        for leg, sec in summary.get("legs", {}).items():
+            legs[leg] = legs.get(leg, 0.0) + sec
+    utils = {h: s.get("utilization", 0.0) for h, s in rows.items()}
+    hot = max(utils, key=utils.get)
+    cold = min(utils, key=utils.get)
+    return {
+        "hosts": len(rows),
+        "utilization": round(sum(utils.values()) / len(utils), 4),
+        "binding_resource": (max(legs.items(), key=lambda kv: kv[1])[0]
+                             if legs else "idle"),
+        "legs": {leg: round(sec, 4) for leg, sec in sorted(legs.items())},
+        "alerting": any(s.get("alerting") for s in rows.values()),
+        "spread": {
+            "hot_host": hot, "hot_utilization": round(utils[hot], 4),
+            "cold_host": cold, "cold_utilization": round(utils[cold], 4),
+            "utilization_delta": round(utils[hot] - utils[cold], 4),
+        },
+    }
+
+
+def fleet_top(endpoints: Dict[str, str],
+              timeout: float = 5.0) -> Dict[str, Any]:
+    """`admin top` over a live cluster: scrape every host's /timeseries,
+    summarize each, aggregate. `endpoints` maps host name → host:port
+    (rpc/cluster.Cluster.http_ports shape). A host that fails to scrape
+    gets an error row instead of sinking the rollup — `admin top` must
+    work BEST when the fleet is unhealthy."""
+    hosts: Dict[str, Dict[str, Any]] = {}
+    for name, endpoint in sorted(endpoints.items()):
+        try:
+            hosts[name] = summarize_windows(
+                scrape_timeseries(endpoint, timeout=timeout))
+        except Exception as exc:
+            hosts[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return {"hosts": hosts, "cluster": _cluster_rollup(hosts)}

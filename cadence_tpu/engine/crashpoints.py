@@ -48,6 +48,8 @@ import signal
 import threading
 from typing import Optional
 
+from ..utils import flightrecorder
+
 SITE_BEFORE_WRITE = "wal.append.before-write"
 SITE_MID_RECORD = "wal.append.mid-record"
 SITE_AFTER_WRITE = "wal.append.after-write"
@@ -97,7 +99,12 @@ class CrashPoint:
 
     def crash(self, detail: str = "") -> None:
         """Die, per mode. Never returns."""
+        flightrecorder.emit("crashpoint-fire", site=self.site,
+                            mode=self.mode, hit=self.hit, detail=detail)
         if self.mode == "kill":
+            # SIGKILL runs no handler: the black box must write out NOW
+            # or the post-mortem loses this process's entire timeline
+            flightrecorder.dump_on_crash()
             os.kill(os.getpid(), signal.SIGKILL)
         raise SimulatedCrash(
             f"crashpoint {self.site}"
@@ -128,6 +135,10 @@ def parse_spec(spec: str) -> CrashPoint:
 def install(point: Optional[CrashPoint]) -> None:
     """Programmatic installation (tests/CrashSim); None uninstalls."""
     global _ACTIVE, _LOADED_ENV
+    if point is not None:
+        flightrecorder.emit("crashpoint-arm", site=point.site,
+                            mode=point.mode, hit=point.hit,
+                            record_type=point.record_type)
     _ACTIVE = point
     _LOADED_ENV = True  # explicit choice overrides the env default
 

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.enums import EMPTY_EVENT_ID, WorkflowState
 from ..core.events import HistoryEvent, RetryPolicy
 from ..oracle.mutable_state import MutableState
+from ..utils import flightrecorder
 from ..utils import metrics as m
 from ..utils import tracing
 from ..utils.clock import RealTimeSource
@@ -145,6 +146,7 @@ class Frontend:
             series = self._domain_series(m.M_QUOTA_SHED, domain)
             if series:
                 self.metrics.inc(m.SCOPE_QUOTAS, series)
+            flightrecorder.emit("quota-shed", domain=domain, api=scope)
             raise
         self.metrics.inc(m.SCOPE_QUOTAS, m.M_QUOTA_ADMITTED)
         series = self._domain_series(m.M_QUOTA_ADMITTED, domain)

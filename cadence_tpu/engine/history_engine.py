@@ -44,6 +44,7 @@ from ..oracle import task_generator as taskgen
 from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
 from ..oracle.retry import retry_activity
 from ..oracle.state_builder import StateBuilder
+from ..utils import flightrecorder
 from ..utils import metrics as m
 from ..utils import tracing
 from ..utils.clock import TimeSource
@@ -1734,6 +1735,14 @@ class _Txn:
         # oracle applied and persisted above; the scheduler maintains the
         # HBM-resident twin and gates per-transaction parity
         self.engine._hand_to_serving(self.ms, events_blob, batch)
+        flightrecorder.emit(
+            "txn-commit", domain_id=info.domain_id,
+            workflow_id=info.workflow_id, run_id=info.run_id,
+            shard_id=self.engine.shard.shard_id,
+            first_event_id=self.events[0].id,
+            next_event_id=info.next_event_id,
+            events=len(self.events), transfer_tasks=len(new_transfer),
+            timer_tasks=len(new_timer))
         for fn in self._post:
             fn()
         self.engine._enforce_history_limits(self.ms)

@@ -62,6 +62,7 @@ import numpy as np
 
 from ..core.checksum import STICKY_ROW_INDEX, payload_row
 from ..core.enums import WorkflowState
+from ..utils import flightrecorder
 from ..utils import metrics as m
 from . import snapshot as snapshot_mod
 from .cache import ContentAddress, batch_crc
@@ -223,6 +224,10 @@ class MigrationManager:
                     scope.inc(m.M_MIG_EVICTED)
                 self.tpu.pack_cache.invalidate(key)
         self.last_out = report
+        flightrecorder.emit(
+            "migration-out", host=self.host, shards=report.shards,
+            considered=report.considered, snapshotted=report.snapshotted,
+            skipped=report.skipped, evicted=report.evicted)
         return report
 
     def drain_host(self, evict: bool = False) -> OutReport:
@@ -330,6 +335,10 @@ class MigrationManager:
                     continue
                 self._finish_key(key, report, anchors, expected, targets)
         self.last_in = report
+        flightrecorder.emit(
+            "migration-in", host=self.host, shards=report.shards,
+            considered=report.considered, hydrated=report.hydrated,
+            suffix_events=report.suffix_events, cold=report.cold)
         return report
 
     def _seed_key(self, key, report: InReport, anchors, expected,

@@ -131,6 +131,25 @@ class Onebox:
             self.metrics.inc(cm.SCOPE_TPU_VISIBILITY, metric, 0)
         self.metrics.gauge(cm.SCOPE_TPU_VISIBILITY, cm.M_VIS_STALENESS,
                            0.0)
+        # cluster telemetry plane (utils/timeseries, utils/hostprof,
+        # utils/flightrecorder): constructed but NOT thread-started —
+        # tests build boxes constantly and AdminHandler's timeseries/
+        # hostprof verbs burst-sample on demand. Anchoring the sampler's
+        # baseline here makes the first admin sample a window spanning
+        # box-build → now. New-scope series pre-register so a scrape
+        # distinguishes "telemetry idle" from "series missing".
+        from ..utils.hostprof import HostProfiler
+        from ..utils.timeseries import TimeSeriesSampler
+        self.timeseries = TimeSeriesSampler(self.metrics)
+        self.timeseries.sample_once()
+        self.hostprof = HostProfiler(self.metrics)
+        self.metrics.inc(cm.SCOPE_FLIGHTREC, "events", 0)
+        self.metrics.inc(cm.SCOPE_FLIGHTREC, "dumps", 0)
+        for gauge in ("samples", "gil-contention", "attributed-share",
+                      "threads"):
+            self.metrics.gauge(cm.SCOPE_HOSTPROF, gauge, 0.0)
+        for gauge in ("windows", "samples", "utilization"):
+            self.metrics.gauge(cm.SCOPE_TIMESERIES, gauge, 0.0)
 
     def enable_serving(self):
         """Wire the serving tier programmatically (tests / the loadgen
@@ -236,8 +255,22 @@ class Onebox:
                     "hosts": list(self.hosts),
                     "matching_backlog": self.matching.backlog()}
 
+        from ..utils import flightrecorder
+
+        def timeseries_doc():
+            self.timeseries.sample_once()
+            return self.timeseries.doc()
+
+        def flightrec_doc():
+            recorder = flightrecorder.DEFAULT_RECORDER
+            return {"stats": recorder.stats(),
+                    "events": recorder.snapshot(200)}
+
         return ObservabilityHTTPServer(self.metrics, health_fn=health,
-                                       tracer=self.tracer, address=address)
+                                       tracer=self.tracer, address=address,
+                                       timeseries_fn=timeseries_doc,
+                                       hostprof_fn=self.hostprof.rollup,
+                                       flightrec_fn=flightrec_doc)
 
     # -- recovery ----------------------------------------------------------
 

@@ -69,6 +69,7 @@ import numpy as np
 
 from ..core.checksum import crc32_of_row
 from ..utils import compile_cache
+from ..utils import flightrecorder
 from ..utils import metrics as m
 from ..utils.profiler import ReplayProfiler
 from ..utils.quotas import ServiceBusyError
@@ -537,6 +538,11 @@ class ServingScheduler:
         dt = time.perf_counter() - t_flush
         self._flush_ewma_s = (0.7 * self._flush_ewma_s + 0.3 * dt
                               if self._flush_ewma_s else dt)
+        flightrecorder.emit(
+            "serving-drain", txns=len(batch),
+            coalesced=sum(i.coalesced for i in batch),
+            suffix=len(suffix_items), cold=len(cold),
+            flush_s=round(dt, 6), queue_depth=len(self._pending))
 
     def _route_full_read(self, item: _Pending, suffix, suffix_items,
                          cold) -> None:

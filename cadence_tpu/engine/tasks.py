@@ -126,8 +126,12 @@ class TaskScheduler:
         self._active = 0
         self._idle = threading.Condition(self._lock)
         self.dead: List[tuple] = []
-        self._threads = [threading.Thread(target=self._worker, daemon=True)
-                         for _ in range(num_workers)]
+        # named per the hostprof subsystem table (utils/hostprof.py):
+        # unnamed pool threads land in "other" and count against the
+        # profiler's attributed share
+        self._threads = [threading.Thread(target=self._worker, daemon=True,
+                                          name=f"cadence-task-worker-{i}")
+                         for i in range(num_workers)]
         for t in self._threads:
             t.start()
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..utils import flightrecorder
 from .durability import (
     WAL_VERSION,
     RecoveryReport,
@@ -242,6 +243,13 @@ def fsck(path: str, metrics=None, verify_on_device: bool = False,
     if metrics is None:
         from ..utils.metrics import DEFAULT_REGISTRY
         metrics = DEFAULT_REGISTRY
+    for finding in report.findings[:32]:
+        # the black box keeps a bounded sample of findings (a corrupt
+        # WAL can produce thousands; 32 is plenty to orient a
+        # post-mortem — the full set is in the report/metrics)
+        flightrecorder.emit("fsck-finding", code=finding.code,
+                            subject=finding.subject,
+                            detail=finding.detail, path=path)
     for finding in report.findings:
         metrics.inc("walcheck", f"finding-{finding.code}")
     metrics.inc("walcheck", "runs")

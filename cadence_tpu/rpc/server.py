@@ -27,6 +27,7 @@ import argparse
 import os
 import socketserver
 import threading
+import time
 from contextlib import nullcontext
 from typing import Dict, Optional, Tuple
 
@@ -37,7 +38,11 @@ from ..engine.history_engine import HistoryEngine
 from ..engine.matching import MatchingEngine
 from ..engine.membership import HashRing
 from ..engine.queues import QueueProcessors
+from ..loadgen.slo import BurnRateEvaluator, BurnTarget
 from ..utils import deadline as deadline_mod
+from ..utils import flightrecorder
+from ..utils import hostprof as hostprof_mod
+from ..utils import timeseries as timeseries_mod
 from ..utils import tracing
 from ..utils.circuitbreaker import (
     BreakerRegistry,
@@ -48,6 +53,18 @@ from ..utils.clock import RealTimeSource
 from ..utils.deadline import DeadlineExceeded
 from .client import RemoteEngine, RemoteMatching, RemoteStores
 from .wire import recv_frame, send_frame, verify_hello
+
+#: server-side p99 latency ceiling (ms) the burn-rate evaluator watches
+#: over the frontend start/signal histograms
+ENV_SLO_P99_MS = "CADENCE_TPU_SLO_P99_MS"
+
+
+def _slo_p99_s() -> float:
+    try:
+        return max(0.001,
+                   float(os.environ.get(ENV_SLO_P99_MS, "500")) / 1000.0)
+    except ValueError:
+        return 0.5
 
 
 class RoutedMatching:
@@ -350,13 +367,52 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         if crash_spec:
             from ..engine import crashpoints
             crashpoints.install(crashpoints.parse_spec(crash_spec))
+        # -- cluster telemetry plane ----------------------------------------
+        # the process-global flight recorder counts onto THIS host's
+        # registry (one host per process in production; in-process test
+        # hosts share the ring, which is exactly the interleaved timeline
+        # a post-mortem wants); sampler + profiler objects always exist
+        # (the admin ops and scrape endpoints need them) but their
+        # threads only start in start(), each gated on its env knob
+        flightrecorder.DEFAULT_RECORDER.metrics = self.metrics
+        self.metrics.inc(cm.SCOPE_FLIGHTREC, "events", 0)
+        self.metrics.inc(cm.SCOPE_FLIGHTREC, "dumps", 0)
+        self.timeseries = timeseries_mod.TimeSeriesSampler(self.metrics)
+        if self.serving is not None:
+            serving_ref = self.serving
+            self.timeseries.set_capacity(
+                cm.SCOPE_TPU_SERVING, cm.M_SERVING_QUEUE_DEPTH,
+                lambda: serving_ref.max_queue)
+        self.hostprof = hostprof_mod.HostProfiler(self.metrics)
+        for gauge in ("samples", "gil-contention", "attributed-share",
+                      "threads"):
+            self.metrics.gauge(cm.SCOPE_HOSTPROF, gauge, 0.0)
+        for gauge in ("windows", "samples", "utilization"):
+            self.metrics.gauge(cm.SCOPE_TIMESERIES, gauge, 0.0)
+        # server-side SLO: frontend start/signal latency p99 under the
+        # CADENCE_TPU_SLO_P99_MS ceiling; evaluated on every sampler tick
+        # so the burn gauges land inside the NEXT /timeseries window and
+        # `admin top` reads them fleet-wide with no extra endpoint
+        slo_s = _slo_p99_s()
+        self.burn = BurnRateEvaluator(
+            self.timeseries,
+            [BurnTarget("frontend-start", cm.SCOPE_FRONTEND_START,
+                        cm.M_LATENCY, slo_s),
+             BurnTarget("frontend-signal", cm.SCOPE_FRONTEND_SIGNAL,
+                        cm.M_LATENCY, slo_s)],
+            registry=self.metrics)
+        self.timeseries.on_sample = lambda window: self.burn.evaluate()
         self.tracer = tracing.DEFAULT_TRACER
-        #: HTTP scrape surface (/metrics, /health, /traces): bound in
-        #: __init__ so the port is known before start(); 0 = ephemeral
+        #: HTTP scrape surface (/metrics, /health, /traces, /timeseries,
+        #: /hostprof, /flightrec): bound in __init__ so the port is known
+        #: before start(); 0 = ephemeral
         from ..utils.scrape import ObservabilityHTTPServer
         self.scrape = ObservabilityHTTPServer(
             self.metrics, health_fn=self._health, tracer=self.tracer,
-            address=(address[0], http_port))
+            address=(address[0], http_port),
+            timeseries_fn=self.timeseries_doc,
+            hostprof_fn=self.hostprof_doc,
+            flightrec_fn=self.flightrec_doc)
         #: shared across every engine this host creates (multi-cluster
         #: replication publish seam)
         self._publisher_holder: Dict[str, object] = {"pub": None}
@@ -393,10 +449,12 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.scheduler = TaskScheduler(num_workers=4)
         self._stop = threading.Event()
         self._beat_thread = threading.Thread(target=self._beat_loop,
-                                             daemon=True)
+                                             daemon=True,
+                                             name="cadence-membership-beat")
         self._pump_interval = pump_interval
         self._pump_thread = threading.Thread(target=self._pump_loop,
-                                             daemon=True)
+                                             daemon=True,
+                                             name="cadence-queue-pump")
 
     # -- engines -----------------------------------------------------------
 
@@ -569,6 +627,40 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                 self.tpu.resident)
         return doc
 
+    # -- telemetry docs (scrape endpoints + the admin_* wire ops) ----------
+
+    def timeseries_doc(self, last_n: Optional[int] = 120) -> Dict[str, object]:
+        """The GET /timeseries body: the ring windows plus the current
+        burn-rate verdict (evaluated fresh, unpublished — the published
+        gauges already ride the windows with one-tick lag)."""
+        doc = self.timeseries.doc(last_n)
+        doc["host"] = self.name
+        doc["slo"] = self.burn.evaluate(publish=False)
+        return doc
+
+    def hostprof_doc(self, duration_s: float = 0.0) -> Dict[str, object]:
+        """The GET /hostprof body. With the profiler thread running the
+        rollup is free; a host running with CADENCE_TPU_HOSTPROF=0 can
+        still be burst-profiled by passing duration_s (the wire op's
+        knob)."""
+        prof = self.hostprof
+        if duration_s > 0 and (prof._thread is None
+                               or not prof._thread.is_alive()):
+            deadline = time.monotonic() + duration_s
+            while True:
+                prof.sample_once()
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(prof.period_s)
+        doc = prof.rollup()
+        doc["host"] = self.name
+        return doc
+
+    def flightrec_doc(self, last_n: int = 200) -> Dict[str, object]:
+        recorder = flightrecorder.DEFAULT_RECORDER
+        return {"host": self.name, "stats": recorder.stats(),
+                "events": recorder.snapshot(last_n)}
+
     # -- health (the /health probe body) -----------------------------------
 
     def _health(self) -> Dict[str, object]:
@@ -623,14 +715,31 @@ class ServiceHost(socketserver.ThreadingTCPServer):
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # arm the black box FIRST: a host that dies during boot should
+        # still leave its record behind
+        flightrecorder.install_dump_handlers()
+        flightrecorder.emit("host-boot", host=self.name,
+                            cluster=self.cluster_name, port=self.port,
+                            shards=self.num_shards)
         self.refresh_membership()
         self._beat_thread.start()
         self._pump_thread.start()
         self.scrape.start()
-        threading.Thread(target=self.serve_forever, daemon=True).start()
+        if timeseries_mod.enabled():
+            self.timeseries.start()
+        if hostprof_mod.enabled():
+            self.hostprof.start()
+        threading.Thread(target=self.serve_forever, daemon=True,
+                         name="cadence-rpc-accept").start()
 
     def stop(self) -> None:
+        flightrecorder.emit("host-stop", host=self.name)
         self._stop.set()
+        for telemetry in (self.timeseries, self.hostprof):
+            try:
+                telemetry.stop()
+            except Exception:
+                pass
         if self.serving is not None:
             try:
                 self.serving.stop()
@@ -658,6 +767,9 @@ class _Handler(socketserver.BaseRequestHandler):
         hop to a DEAD PEER was refused) is an op ERROR to report to the
         caller — only failures on THIS socket end the connection."""
         server: ServiceHost = self.server  # type: ignore[assignment]
+        # name the per-connection thread so hostprof attributes RPC
+        # service time to rpc-dispatch rather than "other"
+        threading.current_thread().name = "cadence-rpc-dispatch"
         try:
             verify_hello(self.request)  # before the first pickle load
         except (OSError, ConnectionError):
@@ -779,6 +891,21 @@ class _Handler(socketserver.BaseRequestHandler):
             result = {"shards": rep.shards, "considered": rep.considered,
                       "snapshotted": rep.snapshotted,
                       "skipped": rep.skipped, "evicted": rep.evicted}
+        elif op == "admin_timeseries":
+            # the /timeseries doc over the wire (operator tooling that
+            # already speaks the protocol need not open the HTTP port)
+            result = server.timeseries_doc(
+                req[1] if len(req) > 1 else 120)
+        elif op == "admin_hostprof":
+            result = server.hostprof_doc(
+                float(req[1]) if len(req) > 1 else 0.0)
+        elif op == "admin_flightrec":
+            result = server.flightrec_doc(
+                req[1] if len(req) > 1 else 200)
+            dump = req[2] if len(req) > 2 else None
+            if dump:
+                result["dumped"] = flightrecorder.DEFAULT_RECORDER.dump(
+                    dump, reason="admin")
         elif op == "ping":
             result = ("pong", server.name,
                       server.controller.owned_shards(),

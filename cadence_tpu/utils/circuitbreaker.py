@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from . import flightrecorder
+
 CLOSED = 0
 OPEN = 1
 HALF_OPEN = 2
@@ -194,6 +196,12 @@ class BreakerRegistry:
 
     def _transition_hook(self, key: Tuple[str, int]):
         def hook(state: int) -> None:
+            # the black box records every transition even when no
+            # registry is wired — breaker flaps around a dead peer are
+            # exactly what a post-mortem reconstructs
+            flightrecorder.emit(
+                "breaker-transition", target=f"{key[0]}:{key[1]}",
+                state=_STATE_NAMES.get(state, str(state)))
             registry = _resolve(self.metrics)
             if registry is None:
                 return

@@ -87,6 +87,25 @@ SCOPE_QUOTAS = "quotas"
 #: (cadence_tpu/loadgen/generator.py); per-domain latency series use the
 #: same domain_metric labeling as the quota counters
 SCOPE_LOADGEN_PREFIX = "loadgen"
+#: host-runtime attribution (utils/hostprof.py HostProfiler): gauges for
+#: per-subsystem wall/CPU shares (wall-share-<subsystem>,
+#: cpu-seconds-<subsystem>), the GIL-contention estimate, and the
+#: attributed-share acceptance gate — the sampling-profiler mirror of
+#: the `admin hostprof` rollup
+SCOPE_HOSTPROF = "host.prof"
+#: ring-buffer sampler health (utils/timeseries.py TimeSeriesSampler):
+#: windows retained, samples taken, last-window utilization — the flat
+#: /metrics mirror of the windowed GET /timeseries surface
+SCOPE_TIMESERIES = "timeseries"
+#: flight-recorder ring (utils/flightrecorder.py): wide events recorded
+#: and JSONL dumps written by THIS process's black box
+SCOPE_FLIGHTREC = "flightrec"
+#: continuous SLO burn rates (loadgen/slo.py BurnRateEvaluator over the
+#: ring-buffer windows): burn-rate-<op>-<metric>-<horizon>s gauges — 1.0
+#: means the error budget is being consumed exactly at its sustainable
+#: rate; multi-window alerting fires when the SHORT and LONG horizons
+#: both exceed the threshold
+SCOPE_SLO = "slo"
 
 # -- metric names -----------------------------------------------------------
 
@@ -449,6 +468,21 @@ class MetricsRegistry:
             self._gauges.clear()
             self._histograms.clear()
 
+    def raw_series(self) -> Tuple[Dict, Dict, Dict]:
+        """Consistent point-in-time copy of every series, taken under ONE
+        lock hold: (counters, gauges, histograms) where each histogram
+        value is (count, total, bounds, bucket_counts-tuple). The
+        time-series sampler's delta math and the prometheus renderer
+        both ride this so a concurrent observe()/reset() can never yield
+        a half-updated view."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = {
+                k: (h.count, h.total, h.bounds, tuple(h.bucket_counts))
+                for k, h in self._histograms.items()}
+        return counters, gauges, histograms
+
     def snapshot(self) -> Dict[str, Dict[str, object]]:
         """Full dump, grouped by scope — the structured emitter seam."""
         out: Dict[str, Dict[str, object]] = {}
@@ -477,11 +511,13 @@ class MetricsRegistry:
         """Render every series in prometheus text format. Scope stays a
         label (the tally-tagged-scope shape), the metric name is
         sanitized into the prometheus grammar: counters get `_total`,
-        histograms emit `_bucket`/`_sum`/`_count` with `le` labels."""
-        with self._lock:
-            counters = dict(self._counters)
-            gauges = dict(self._gauges)
-            histograms = {k: h for k, h in self._histograms.items()}
+        histograms emit `_bucket`/`_sum`/`_count` with `le` labels.
+
+        Renders from raw_series()'s deep copy: the old shallow copy kept
+        live HistogramStat references, so a concurrent observe() could
+        land between the `_bucket` walk and the `_count` line and the
+        exposition's +Inf bucket would disagree with its own count."""
+        counters, gauges, histograms = self.raw_series()
 
         def metric_name(name: str) -> str:
             return prefix + "_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
@@ -510,15 +546,20 @@ class MetricsRegistry:
             mname = metric_name(name)
             header(mname, "gauge")
             lines.append(f'{mname}{{scope="{scope}"}} {fmt(v)}')
-        for (scope, name), hist in by_family(histograms.items()):
+        for (scope, name), (count, total, bounds, buckets) in by_family(
+                histograms.items()):
             mname = metric_name(name)
             header(mname, "histogram")
-            for le, cum in hist.cumulative():
-                lines.append(
-                    f'{mname}_bucket{{scope="{scope}",le="{le}"}} {cum}')
+            running = 0
+            for bound, n in zip(bounds, buckets):
+                running += n
+                lines.append(f'{mname}_bucket{{scope="{scope}",'
+                             f'le="{bound}"}} {running}')
             lines.append(
-                f'{mname}_sum{{scope="{scope}"}} {fmt(round(hist.total, 9))}')
-            lines.append(f'{mname}_count{{scope="{scope}"}} {hist.count}')
+                f'{mname}_bucket{{scope="{scope}",le="+Inf"}} {count}')
+            lines.append(
+                f'{mname}_sum{{scope="{scope}"}} {fmt(round(total, 9))}')
+            lines.append(f'{mname}_count{{scope="{scope}"}} {count}')
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -72,6 +72,14 @@ def _fresh_default_observability():
     # on the next enqueue)
     from cadence_tpu.engine import visibility_device
     visibility_device.reset_all()
+    # the telemetry plane is process-global three ways: the flight
+    # recorder's ring (emit points hold DEFAULT_RECORDER by reference),
+    # and any sampler/profiler threads a test started — stop + clear so
+    # one test's events/windows never surface in another's dumps
+    from cadence_tpu.utils import flightrecorder, hostprof, timeseries
+    flightrecorder.reset_all()
+    timeseries.reset_all()
+    hostprof.reset_all()
     yield
 
 
