@@ -1427,6 +1427,157 @@ def _fuzz_suite(layout, trials: int = 0):
     return table
 
 
+def _replication_suite(layout):
+    """Standby bulk apply (the multi-region standby's steady state): one
+    seeded active-region corpus — serving tier on, mid-corpus forced
+    sweep shipping snapshot records down the stream — published ONCE,
+    then drained by two independent standby consumers off the same
+    replication queue: the device twin ON (snapshot-seeded bulk apply,
+    per-apply parity gate) and the CADENCE_TPU_REPL_DEVICE=0 kill-switch
+    host-only path. Times each apply drain and byte-compares every
+    replicated row across the two paths — the kill switch must restore
+    the host-only result exactly."""
+    from cadence_tpu.core.checksum import payload_row
+    from cadence_tpu.engine.domainrepl import DomainReplicationProcessor
+    from cadence_tpu.engine.multicluster import ReplicatedClusters
+    from cadence_tpu.engine.onebox import Onebox
+    from cadence_tpu.engine.replication import (
+        HistoryReplicator,
+        ReplicationTaskProcessor,
+    )
+    from cadence_tpu.models.deciders import SignalDecider
+    from cadence_tpu.utils import metrics as cm
+
+    domain, tl = "bench-repl", "bench-repl-tl"
+    workflows = int(os.environ.get("BENCH_REPL_WORKFLOWS", "32"))
+    signals = int(os.environ.get("BENCH_REPL_SIGNALS", "6"))
+
+    # aggressive snapshot policy for the corpus (read at Snapshotter
+    # construction, which happens inside ReplicatedClusters.__init__)
+    knobs = {"CADENCE_TPU_SNAPSHOT_MIN_EVENTS": "1",
+             "CADENCE_TPU_SNAPSHOT_EVERY_EVENTS": "4"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
+        host_only = Onebox(num_hosts=1, num_shards=4,
+                           cluster_name="standby")
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    clusters.active.enable_serving()
+    clusters.register_global_domain(domain)
+    wfs = [f"br-wf-{i}" for i in range(workflows)]
+    deciders = {wf: SignalDecider(expected_signals=999) for wf in wfs}
+
+    def drive(box):
+        for _ in range(500):
+            progressed = box.pump_once() > 0
+            while True:
+                resp = box.frontend.poll_for_decision_task(domain, tl)
+                if resp is None:
+                    break
+                progressed = True
+                box.frontend.respond_decision_task_completed(
+                    resp.token,
+                    deciders[resp.token.workflow_id].decide(resp.history))
+            if not progressed and box.matching.backlog() == 0:
+                return
+
+    for wf in wfs:
+        clusters.active.frontend.start_workflow_execution(
+            domain, wf, "signal", tl)
+    drive(clusters.active)
+    for s in range(signals):
+        for wf in wfs:
+            clusters.active.frontend.signal_workflow_execution(
+                domain, wf, f"{wf}-s{s}")
+        drive(clusters.active)
+        if s == signals // 2 - 1:
+            # mid-corpus snapshot shipment: everything after this is the
+            # suffix the standby's device twin applies on seeded keys
+            clusters.active.serving.drain(timeout=60)
+            clusters.active.tpu.snapshotter().sweep(force=True)
+    clusters.active.serving.drain(timeout=60)
+    clusters.active.serving.stop()
+
+    clusters.domain_processor.process_once()
+    DomainReplicationProcessor(clusters.active.stores, host_only.stores,
+                               "standby").process_once()
+
+    def timed_drain(proc):
+        t0 = time.perf_counter()
+        total = 0
+        while True:
+            n = proc.process_once(batch_size=100)
+            total += n
+            if n == 0:
+                return total, time.perf_counter() - t0
+
+    def events_applied(box):
+        return sum(
+            box.stores.execution.get_workflow(*key)
+            .execution_info.next_event_id - 1
+            for key in box.stores.history.list_runs())
+
+    device_tasks, device_s = timed_drain(clusters.processor)
+    host_proc = ReplicationTaskProcessor(
+        HistoryReplicator(host_only.stores, rebuilder=host_only.rebuilder,
+                          notifier=host_only.notifier),
+        clusters.publisher, host_only.stores,
+        source_history_reader=clusters._read_source_history,
+        tpu=host_only.tpu)
+    host_proc.metrics = host_only.metrics
+    prev = os.environ.get("CADENCE_TPU_REPL_DEVICE")
+    os.environ["CADENCE_TPU_REPL_DEVICE"] = "0"
+    try:
+        host_tasks, host_s = timed_drain(host_proc)
+    finally:
+        os.environ.pop("CADENCE_TPU_REPL_DEVICE", None) if prev is None \
+            else os.environ.__setitem__("CADENCE_TPU_REPL_DEVICE", prev)
+
+    rows, identical = 0, True
+    for key in clusters.standby.stores.history.list_runs():
+        a = payload_row(clusters.standby.stores.execution.get_workflow(*key))
+        b = payload_row(host_only.stores.execution.get_workflow(*key))
+        rows += 1
+        if not (a == b).all():
+            identical = False
+    events = events_applied(clusters.standby)
+
+    def repl_counter(reg, name):
+        return reg.counter(cm.SCOPE_REPLICATION, name)
+
+    dreg, hreg = clusters.standby.metrics, host_only.metrics
+    return {
+        "workflows": workflows, "signals_per_workflow": signals,
+        "events_replicated": events, "rows_compared": rows,
+        "device": {
+            "tasks": device_tasks,
+            "drain_s": round(device_s, 4),
+            "events_per_sec": round(events / device_s) if device_s else 0,
+            "applied": repl_counter(dreg, cm.M_REPL_DEVICE_APPLIED),
+            "suffix_events": repl_counter(dreg,
+                                          cm.M_REPL_DEVICE_SUFFIX_EVENTS),
+            "cold": repl_counter(dreg, cm.M_REPL_DEVICE_COLD),
+            "divergence": repl_counter(dreg, cm.M_REPL_DEVICE_DIVERGENCE),
+            "snapshots_installed": repl_counter(dreg,
+                                                cm.M_REPL_SNAP_INSTALLED),
+        },
+        "host_only": {
+            "kill_switch": "CADENCE_TPU_REPL_DEVICE=0",
+            "tasks": host_tasks,
+            "drain_s": round(host_s, 4),
+            "events_per_sec": round(events / host_s) if host_s else 0,
+            "device_applied": repl_counter(hreg, cm.M_REPL_DEVICE_APPLIED),
+            "snapshots_installed": repl_counter(hreg,
+                                                cm.M_REPL_SNAP_INSTALLED),
+        },
+        "paths_byte_identical": identical,
+    }
+
+
 def main() -> None:
     ns_workflows = int(os.environ.get("BENCH_NS_WORKFLOWS", "1000000"))
     ns_events = int(os.environ.get("BENCH_NS_EVENTS", "1000"))
@@ -1458,6 +1609,7 @@ def main() -> None:
     visibility = _visibility_suite()
     feeder = _feeder_rate(layout)
     fuzz = _fuzz_suite(layout)
+    replication = _replication_suite(layout)
 
     # observability snapshot: the profiler's pack/h2d/kernel/readback leg
     # decomposition (fed by the instrumented feeder path) plus every tpu.*
@@ -1500,6 +1652,7 @@ def main() -> None:
             "visibility": visibility,
             "feeder": feeder,
             "fuzz": fuzz,
+            "replication": replication,
             "observability": observability,
         },
     }))

@@ -169,6 +169,14 @@ def main(argv=None) -> int:
     adm.add_parser("dlq-read")
     adm.add_parser("dlq-purge")
     adm.add_parser("dlq-merge")
+    # DLQ observability rollup + redrive through the resender
+    # (`admin dlq` / `admin dlq redrive`); --http runs the wire arm
+    # against a live service host (admin_dlq op)
+    dlqp = adm.add_parser("dlq")
+    dlqp.add_argument("action", nargs="?", default="summary",
+                      choices=("summary", "redrive"))
+    dlqp.add_argument("--http", default="",
+                      help="HOST:PORT of a live service host (wire arm)")
     fo = adm.add_parser("failover")
     fo.add_argument("--domain", required=True)
     fo.add_argument("--to", required=True, help="target active cluster")
@@ -270,6 +278,11 @@ def main(argv=None) -> int:
                          "(serving tier + wire/store chaos + crashpoint "
                          "kills) and gate zero divergence")
     fr.add_argument("--interleave-seed", type=int, default=20260804)
+    fr.add_argument("--replication", action="store_true",
+                    help="also fuzz the replication seam (standby apply "
+                         "pump + device twin vs live traffic, split-brain "
+                         "NDC promotion, poison-task quarantine)")
+    fr.add_argument("--replication-seed", type=int, default=20260806)
     fr.add_argument("--record", action="store_true",
                     help="write the next FUZZ_r0N.json in CWD")
     fr.add_argument("--out", default="",
@@ -347,6 +360,31 @@ def main(argv=None) -> int:
     cl.add_argument("--record", action="store_true",
                     help="write the next LOADGEN_r0N.json in CWD")
     cl.add_argument("--out", default="",
+                    help="explicit trajectory path (implies --record)")
+    # the two-region kill-the-active-region scenario (wire regions with
+    # continuous replication + snapshot shipping; gates promoted-region
+    # p99, bounded pre-kill lag, warm steals >= the floor, zero
+    # divergence, both-region verify; records events/s/fleet)
+    rg = load_grp.add_parser("region")
+    rg.add_argument("--duration", type=float, default=10.0,
+                    help="per traffic phase (active + promoted)")
+    rg.add_argument("--hosts", type=int, default=2,
+                    help="service hosts per region")
+    rg.add_argument("--rps", type=float, default=10.0)
+    rg.add_argument("--pool-size", type=int, default=12)
+    rg.add_argument("--kill-at", type=float, default=0.6,
+                    help="kill the active region at this fraction of "
+                         "the phase-1 window")
+    rg.add_argument("--workers", type=int, default=16)
+    rg.add_argument("--seed", type=int, default=20260806)
+    rg.add_argument("--p99-slo-ms", type=float, default=8000.0)
+    rg.add_argument("--hydration-floor", type=float, default=0.8)
+    rg.add_argument("--max-repl-lag", type=int, default=64,
+                    help="max unconsumed replication tasks at the kill")
+    rg.add_argument("--no-verify", action="store_true")
+    rg.add_argument("--record", action="store_true",
+                    help="write the next LOADGEN_r0N.json in CWD")
+    rg.add_argument("--out", default="",
                     help="explicit trajectory path (implies --record)")
     for cmd_name in ("run", "overload"):
         lp = load_grp.add_parser(cmd_name)
@@ -601,6 +639,25 @@ def main(argv=None) -> int:
             for entry, _err in still_failed:
                 box.stores.queue.enqueue(REPLICATION_DLQ, entry)
             _emit({"applied": applied, "still_failed": len(still_failed)})
+        elif args.cmd == "dlq":
+            if args.http:
+                from .rpc.wire import call as wire_call
+                h, p = args.http.rsplit(":", 1)
+                _emit(wire_call((h, int(p)), ("admin_dlq", args.action),
+                                timeout=60))
+                return 0
+            from .engine.replication import (
+                HistoryReplicator,
+                ReplicationPublisher,
+                ReplicationTaskProcessor,
+            )
+            proc = ReplicationTaskProcessor(
+                HistoryReplicator(box.stores, rebuilder=box.rebuilder,
+                                  notifier=box.notifier),
+                ReplicationPublisher(box.stores), box.stores, tpu=box.tpu)
+            proc.metrics = box.metrics
+            _emit(proc.redrive_dlq() if args.action == "redrive"
+                  else proc.dlq_summary())
         elif args.cmd == "profile":
             # pprof → JAX profiler (SURVEY §5): capture an XLA trace of a
             # representative replay; the trace opens in TensorBoard's
@@ -831,6 +888,12 @@ def _fuzz_tool(args) -> int:
             ilv = interleave_scenario(seed=args.interleave_seed)
             doc["interleave"] = ilv
             doc["ok"] = bool(doc["ok"] and ilv["ok"])
+        if args.replication:
+            from .gen.interleave import replication_interleave_scenario
+            rilv = replication_interleave_scenario(
+                seed=args.replication_seed)
+            doc["replication_interleave"] = rilv
+            doc["ok"] = bool(doc["ok"] and rilv["ok"])
         if args.record or args.out:
             doc["trajectory"] = fuzz_mod.write_fuzz_trajectory(
                 doc, path=args.out or None)
@@ -889,6 +952,13 @@ def _load_tool(args) -> int:
             pool_size=args.pool_size, kill_at_frac=args.kill_at,
             seed=args.seed, p99_slo_ms=args.p99_slo_ms,
             workers=args.workers, hydration_floor=args.hydration_floor)
+    elif args.cmd == "region":
+        doc = scenarios.region_failover_scenario(
+            duration_s=args.duration, num_hosts=args.hosts, rps=args.rps,
+            pool_size=args.pool_size, kill_at_frac=args.kill_at,
+            seed=args.seed, p99_slo_ms=args.p99_slo_ms,
+            workers=args.workers, hydration_floor=args.hydration_floor,
+            max_repl_lag=args.max_repl_lag, verify=not args.no_verify)
     elif args.cmd == "overload":
         doc = scenarios.overload_scenario(
             duration_s=args.duration, num_hosts=args.hosts,

@@ -481,10 +481,16 @@ def queue_record(queue: str, payload) -> dict:
 
     from .crosscluster import CrossClusterTask
     from .domainrepl import DomainReplicationTask
-    from .replication import DLQEntry, ReplicationTask
+    from .replication import DLQEntry, ReplicationTask, ShippedSnapshotTask
     if isinstance(payload, ReplicationTask):
         body = _repl_task_dict(payload)
         kind = "task"
+    elif isinstance(payload, ShippedSnapshotTask):
+        # snapshot-shipping replication: the shipped record reuses the
+        # "snap" body format, wrapped with its source-cluster tag
+        body = {"src": payload.source_cluster,
+                "rec": snapshot_record(payload.record)}
+        kind = "snapship"
     elif isinstance(payload, DLQEntry):
         body = {"task": _repl_task_dict(payload.task), "err": payload.error}
         kind = "dlq"
@@ -671,6 +677,15 @@ def recover_stores(path: str, verify_on_device: bool = True,
             elif rec["k"] == "xc":
                 from .crosscluster import CrossClusterTask
                 stores.queue.enqueue(rec["q"], CrossClusterTask(**rec["p"]))
+            elif rec["k"] == "snapship":
+                from .replication import ShippedSnapshotTask
+                try:
+                    stores.queue.enqueue(rec["q"], ShippedSnapshotTask(
+                        record=snapshot_from_record(rec["p"]["rec"]),
+                        source_cluster=rec["p"].get("src", "")))
+                except Exception:
+                    pass  # malformed shipped record: the consumer's own
+                    # torn/foreign gates would have ignored it anyway
             else:
                 from .replication import DLQEntry
                 stores.queue.enqueue(rec["q"], DLQEntry(

@@ -1672,6 +1672,19 @@ class _Txn:
         if not self.events:
             return
         info = self.ms.execution_info
+        # version arbitration, pre-apply: a split-brain peer's promotion
+        # may have landed on this workflow through replication (its
+        # current branch now ends at a HIGHER failover version) before
+        # this cluster's domain record caught up — this write would lose
+        # NDC arbitration anyway, so reject it typed and untouched
+        # instead of letting the version-history guard blow up mid-apply
+        vh = self.ms.version_histories.current()
+        if vh.items and vh.last_item().version > self.events[0].version:
+            from .domain import DomainNotActiveError
+            raise DomainNotActiveError(
+                self.ms.domain_entry.name,
+                f"the failover-version-{vh.last_item().version} cluster",
+                f"a failover-version-{self.events[0].version} writer")
         batch = HistoryBatch(domain_id=info.domain_id,
                              workflow_id=info.workflow_id,
                              run_id=info.run_id, events=self.events)

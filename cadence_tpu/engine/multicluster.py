@@ -19,13 +19,32 @@ from .replication import (
     ReplicationPublisher,
     ReplicationTaskProcessor,
 )
+from .task_refresher import sweep_refresh
 
 
 def _refresh_domain_tasks(box: Onebox, domain_name: str) -> None:
     """Promotion sweep for one domain (shared sweep in task_refresher)."""
-    from .task_refresher import sweep_refresh
     domain_id = box.stores.domain.by_name(domain_name).domain_id
     sweep_refresh(box.stores, box.route, domain_id)
+
+
+def prehydrate_serving(box: Onebox) -> dict:
+    """Warm promotion (tentpole 3): hydrate the promoting box's serving
+    tier from its snapshot store — which snapshot-shipping replication
+    has been filling continuously — BEFORE the active flip, so the first
+    post-failover transactions land on resident rows instead of paying a
+    cold replay storm. One pass of the migration tier's shared hydration
+    primitive over every shard (seed_caches + batch-range suffix replay,
+    oracle parity gated)."""
+    from .migration import MigrationManager
+    mgr = MigrationManager(box.cluster_name, box.num_shards, box.tpu,
+                           registry=box.metrics)
+    report = mgr.hydrate_shards(range(box.num_shards))
+    return {"considered": report.considered, "hydrated": report.hydrated,
+            "suffix_events": report.suffix_events, "cold": report.cold,
+            "young": report.young, "stale": report.stale,
+            "already_resident": report.already_resident,
+            "parity_divergence": report.parity_divergence}
 
 
 class ReplicatedClusters:
@@ -44,8 +63,14 @@ class ReplicatedClusters:
                                             notifier=self.standby.notifier)
         self.processor = ReplicationTaskProcessor(
             self.replicator, self.publisher, self.standby.stores,
-            source_history_reader=self._read_source_history)
+            source_history_reader=self._read_source_history,
+            tpu=self.standby.tpu)
         self.processor.metrics = self.standby.metrics
+        # snapshot-shipping replication: every record the active side's
+        # post-append policy writes rides the same stream, so the
+        # standby's cold admits and its promotion are suffix replays
+        self.active.tpu.snapshotter().shipper = (
+            lambda rec: self.publisher.publish_snapshot(rec, "primary"))
         # reverse direction (standby → active): every cluster in an NDC
         # group both publishes and consumes (task_fetcher.go polls every
         # remote cluster); needed for post-split-brain reconciliation
@@ -57,8 +82,12 @@ class ReplicatedClusters:
         self.reverse_processor = ReplicationTaskProcessor(
             self.reverse_replicator, self.reverse_publisher,
             self.active.stores,
-            source_history_reader=self._read_standby_history)
+            source_history_reader=self._read_standby_history,
+            tpu=self.active.tpu)
         self.reverse_processor.metrics = self.active.metrics
+        self.standby.tpu.snapshotter().shipper = (
+            lambda rec: self.reverse_publisher.publish_snapshot(
+                rec, "standby"))
         # domain-metadata replication (common/domain/replication_queue.go
         # + worker/replicator): active-side domain mutations stream to the
         # standby, which recomputes is_active from its own cluster name

@@ -327,6 +327,21 @@ class QueueProcessors:
     def _execute_transfer(self, engine: "HistoryEngine", domain_id: str,
                           workflow_id: str, run_id: str,
                           task: GeneratedTask) -> None:
+        from .domain import DomainNotActiveError
+        try:
+            self._execute_transfer_active(engine, domain_id, workflow_id,
+                                          run_id, task)
+        except DomainNotActiveError:
+            # version arbitration rejected the mutation pre-apply: a peer
+            # cluster's promotion already landed on this workflow, so the
+            # task belongs to the winner (whose promotion sweep
+            # regenerates it) — drop, like the reference's standby
+            # executors drop active-only tasks
+            self.metrics.inc(SCOPE_QUEUE_TRANSFER, m.M_TASKS_DROPPED_STALE)
+
+    def _execute_transfer_active(self, engine: "HistoryEngine",
+                                 domain_id: str, workflow_id: str,
+                                 run_id: str, task: GeneratedTask) -> None:
         tt = TransferTaskType(task.task_type)
         if tt == TransferTaskType.DecisionTask:
             # processDecisionTask → matching.AddDecisionTask
@@ -670,6 +685,7 @@ class QueueProcessors:
     def _execute_timer(self, engine: "HistoryEngine", domain_id: str,
                        workflow_id: str, run_id: str,
                        task: GeneratedTask) -> None:
+        from .domain import DomainNotActiveError
         tt = TimerTaskType(task.task_type)
         try:
             if tt == TimerTaskType.UserTimer:
@@ -697,6 +713,10 @@ class QueueProcessors:
                                               task)
         except EntityNotExistsError:
             self._dropped_not_exists(SCOPE_QUEUE_TIMER)
+        except DomainNotActiveError:
+            # a peer's promotion owns this workflow now (see the transfer
+            # executor's drop): the winner's sweep regenerates the timer
+            self.metrics.inc(SCOPE_QUEUE_TIMER, m.M_TASKS_DROPPED_STALE)
 
     def _dispatch_activity_retry(self, domain_id: str, workflow_id: str,
                                  run_id: str, task: GeneratedTask) -> None:

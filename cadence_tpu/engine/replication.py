@@ -21,6 +21,7 @@ workflows at once on the TPU (tpu_engine.py), which is BASELINE config 5's
 from __future__ import annotations
 
 import copy
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -28,10 +29,27 @@ from ..core.codec import deserialize_history, serialize_history
 from ..core.events import HistoryBatch, HistoryEvent
 from ..oracle.mutable_state import DomainEntry, MutableState, ReplayError
 from ..oracle.state_builder import StateBuilder
+from ..utils import flightrecorder
+from ..utils import metrics as m
+from . import crashpoints
 from .persistence import EntityNotExistsError, Stores
 
 REPLICATION_QUEUE = "replication"
 REPLICATION_DLQ = "replication-dlq"
+
+#: kill switch: CADENCE_TPU_REPL_DEVICE=0 restores the host-only standby
+#: apply path byte-identically (the pre-device behavior, kept as the
+#: parity-audit configuration, same convention as CADENCE_TPU_MIGRATION)
+ENABLE_DEVICE_ENV = "CADENCE_TPU_REPL_DEVICE"
+
+#: crashpoint sites on the standby apply pump (engine/crashpoints.py):
+#: `repl.apply` fires between reading a task and applying it — recovery
+#: must re-deliver (the ack has not advanced), and the replicator's
+#: branch-head dedup must swallow the duplicate; `repl.ack` fires after
+#: the in-memory ack advanced but before the caller persists it — the
+#: durable ack may lag, never lead, the applied state.
+SITE_REPL_APPLY = "repl.apply"
+SITE_REPL_ACK = "repl.ack"
 
 
 def _items_until(items: Tuple[Tuple[int, int], ...], event_id: int
@@ -91,6 +109,20 @@ class SyncActivityTask:
     version_history_items: Tuple[Tuple[int, int], ...] = ()
 
 
+@dataclass
+class ShippedSnapshotTask:
+    """One checksum-gated device-state snapshot crossing the cluster
+    boundary (tentpole 2 of the warm-failover tier): the source's
+    post-append snapshot policy (engine/snapshot.Snapshotter) ships every
+    record it writes, so the standby's cold admits and its promotion path
+    are `seed_caches` + batch-range suffix replay, never full replay.
+    Rides the same replication queue as history batches — ordering with
+    the batches it covers is preserved by construction."""
+
+    record: object  # engine/snapshot.SnapshotRecord
+    source_cluster: str = ""
+
+
 class RetryReplicationError(Exception):
     """Gap detected: events [from_event_id, to_event_id) must be resent
     first (types.RetryTaskV2Error analog)."""
@@ -140,6 +172,13 @@ class ReplicationPublisher:
             last_worker_identity=ai.last_worker_identity,
             version_history_items=version_history_items,
         ))
+
+    def publish_snapshot(self, record, source_cluster: str = "") -> None:
+        """Ship one post-append SnapshotRecord to every consumer of this
+        cluster's replication stream (the Snapshotter's `shipper` hook
+        calls this right after a successful local put)."""
+        self.stores.queue.enqueue(REPLICATION_QUEUE, ShippedSnapshotTask(
+            record=record, source_cluster=source_cluster))
 
     def read_tasks(self, from_index: int, count: int = 100
                    ) -> List[Tuple[int, ReplicationTask]]:
@@ -484,14 +523,189 @@ class DLQEntry:
     error: str
 
 
+class _DeviceApplier:
+    """Standby device twin of the host apply pump (tentpole 1): after the
+    host `HistoryReplicator` — sole authority on legality — commits a
+    drain's batches, the touched histories stream through the resident
+    tier's grouped from-state launches (`replay_append_report`, the same
+    wirec feeder path the serving flush and migration hydration ride), so
+    the standby's HBM state stays hot at the bulk-ingest rate.
+
+    Per-apply parity gate: every finished row's pinned payload is
+    byte-compared against the oracle's freshly-persisted state
+    (`payload_row`); a mismatch is counted and the row invalidated —
+    divergence is NEVER served. Keys the device cannot take cheaply
+    (multi-branch NDC conflicts, no resident entry and no valid shipped
+    snapshot) stay host-only and are counted cold."""
+
+    def __init__(self, tpu, registry=None) -> None:
+        self.tpu = tpu
+        self.metrics = registry if registry is not None else m.DEFAULT_REGISTRY
+
+    def enabled(self) -> bool:
+        if self.tpu is None:
+            return False
+        if os.environ.get(ENABLE_DEVICE_ENV, "1") in ("0", "false", "off"):
+            return False
+        from . import resident as resident_mod
+        return resident_mod.enabled()
+
+    def apply_keys(self, keys) -> int:
+        """Batch-hydrate/advance `keys` (the drain's applied histories) on
+        the device; returns how many rows finished parity-clean."""
+        import numpy as np
+
+        from ..core.checksum import STICKY_ROW_INDEX, payload_row
+        from ..core.enums import WorkflowState
+        from . import snapshot as snapshot_mod
+        from .cache import ContentAddress, batch_crc
+
+        scope = self.metrics.scope(m.SCOPE_REPLICATION)
+        tpu = self.tpu
+        stores, resident = tpu.stores, tpu.resident
+        pack_cache, layout = tpu.pack_cache, tpu.layout
+        hs = stores.history
+        suffix: List[tuple] = []
+        anchors: Dict[tuple, int] = {}
+        expected: Dict[tuple, tuple] = {}
+        targets: Dict[tuple, ContentAddress] = {}
+        finished: List[tuple] = []
+        for key in keys:
+            try:
+                ms = stores.execution.get_workflow(*key)
+            except Exception:
+                continue
+            if int(ms.execution_info.state) == int(WorkflowState.Completed):
+                # closed runs take no more transactions: nothing to keep hot
+                resident.invalidate(key)
+                continue
+            try:
+                if hs.branch_count(*key) > 1 \
+                        or hs.get_current_branch(*key) != 0:
+                    # NDC conflict territory stays host-only; a pinned row
+                    # from before the branch switch must not linger
+                    resident.invalidate(key)
+                    scope.inc(m.M_REPL_DEVICE_COLD)
+                    continue
+                total = hs.batch_count(*key)
+            except Exception:
+                scope.inc(m.M_REPL_DEVICE_COLD)
+                continue
+            if total == 0:
+                continue
+            entry = resident.entry_for(key)
+            rec = None
+            if entry is None and snapshot_mod.enabled():
+                try:
+                    rec = stores.snapshot.get(key)
+                except Exception:
+                    rec = None
+                if rec is not None and not snapshot_mod.validate_record(
+                        rec, layout, self.metrics):
+                    rec = None
+            if entry is None and rec is None:
+                scope.inc(m.M_REPL_DEVICE_COLD)
+                continue
+            from_addr = entry.address if entry is not None else rec.address
+            if not 0 < from_addr.batch_count <= total:
+                resident.invalidate(key)
+                scope.inc(m.M_REPL_DEVICE_STALE)
+                continue
+            try:
+                part = hs.as_history_batches_range(
+                    *key, from_batch=from_addr.batch_count - 1)
+            except Exception:
+                scope.inc(m.M_REPL_DEVICE_COLD)
+                continue
+            if not part or batch_crc(part[0]) != from_addr.last_batch_crc:
+                # tail overwrite between the pin point and this apply
+                resident.invalidate(key)
+                scope.inc(m.M_REPL_DEVICE_STALE)
+                continue
+            if entry is None:
+                if not snapshot_mod.seed_caches(rec, resident, pack_cache,
+                                                layout, self.metrics):
+                    scope.inc(m.M_REPL_DEVICE_COLD)
+                    continue
+                entry = resident.entry_for(key)
+                if entry is None:
+                    scope.inc(m.M_REPL_DEVICE_COLD)
+                    continue
+            row = payload_row(ms, layout)
+            row[STICKY_ROW_INDEX] = 0
+            expected[key] = (row, int(ms.version_histories.current_index),
+                             int(ms.execution_info.next_event_id))
+            anchors[key] = int(part[-1].events[-1].id)
+            new_addr = ContentAddress(total, batch_crc(part[-1]))
+            targets[key] = new_addr
+            if from_addr.batch_count == total:
+                finished.append(key)  # already at tip (snapshot == tip)
+                continue
+            rows = pack_cache.encode_append(key, from_addr, part[1:],
+                                            new_addr)
+            if rows is None:
+                # interner seed evicted out from under us: leave the key
+                # to the promotion path's full-read admit
+                resident.invalidate(key)
+                scope.inc(m.M_REPL_DEVICE_COLD)
+                continue
+            suffix.append((key, entry, (rows, new_addr)))
+        if suffix:
+            results, append_report = tpu.resident.replay_append_report(
+                suffix,
+                encode_suffix=lambda _k, token, _f: token[0],
+                address_of=lambda token: token[1])
+            scope.inc(m.M_REPL_DEVICE_SUFFIX_EVENTS,
+                      append_report.events_appended)
+            for (key, _entry, _token), res in zip(suffix, results):
+                if not res.ok:
+                    scope.inc(m.M_REPL_DEVICE_COLD)
+                    continue
+                finished.append(key)
+        ok = 0
+        for key in finished:
+            entry = tpu.resident.entry_for(key)
+            if entry is None:
+                scope.inc(m.M_REPL_DEVICE_COLD)
+                continue
+            row, branch, next_id = expected[key]
+            if anchors[key] + 1 != next_id \
+                    or entry.address != targets.get(key):
+                # a foreign commit moved the entry mid-pass (the live
+                # serving tier's own gated parity covered that move)
+                scope.inc(m.M_REPL_DEVICE_APPLIED)
+                scope.inc(m.M_REPL_DEVICE_UNSTABLE)
+                ok += 1
+                continue
+            payload = np.asarray(entry.payload, dtype=np.int64)
+            if (payload == row).all() and int(entry.branch) == branch:
+                scope.inc(m.M_REPL_DEVICE_APPLIED)
+                ok += 1
+            else:
+                # never serve wrong state: drop and count — gated at zero
+                # by the region-failover scenario and detail.replication
+                tpu.resident.invalidate(key)
+                scope.inc(m.M_REPL_DEVICE_DIVERGENCE)
+                flightrecorder.emit("replication-divergence",
+                                    domain=key[0], workflow=key[1],
+                                    run=key[2])
+        return ok
+
+
 class ReplicationTaskProcessor:
     """Target-side pump: polls the source queue, applies tasks, resolves
     gaps via the resender, quarantines poison tasks in the DLQ
-    (replication/task_processor.go + task_fetcher.go)."""
+    (replication/task_processor.go + task_fetcher.go).
+
+    With a `tpu` engine wired (the standby's TPUReplayEngine), each drain
+    additionally streams its applied histories through the device twin
+    (`_DeviceApplier`) and installs shipped snapshots — both strictly
+    downstream of the host replicator's legality decisions."""
 
     def __init__(self, replicator: HistoryReplicator, source: ReplicationPublisher,
                  target_stores: Stores,
-                 source_history_reader: Optional[Callable] = None) -> None:
+                 source_history_reader: Optional[Callable] = None,
+                 tpu=None) -> None:
         self.replicator = replicator
         self.source = source
         self.stores = target_stores
@@ -502,8 +716,18 @@ class ReplicationTaskProcessor:
         self.applied = 0
         self.deduped = 0
         self.resends = 0
-        from ..utils.metrics import DEFAULT_REGISTRY
-        self.metrics = DEFAULT_REGISTRY
+        self.snapshots_installed = 0
+        self._metrics = m.DEFAULT_REGISTRY
+        self.device = _DeviceApplier(tpu, self._metrics)
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, registry) -> None:
+        self._metrics = registry
+        self.device.metrics = registry
 
     def _apply_task(self, task) -> bool:
         """Dispatch by task type (replication/task_executor.go:80 execute)."""
@@ -512,14 +736,25 @@ class ReplicationTaskProcessor:
         return self.replicator.apply(task)
 
     def process_once(self, batch_size: int = 100) -> int:
-        from ..utils import metrics as m
         scope = self.metrics.scope(m.SCOPE_REPLICATION)
         tasks = self.source.read_tasks(self.ack_index, batch_size)
+        touched: List[tuple] = []
+        seen = set()
         for index, task in tasks:
+            crashpoints.fire(SITE_REPL_APPLY)
+            if isinstance(task, ShippedSnapshotTask):
+                self._install_shipped(task, scope)
+                self.ack_index = index + 1
+                crashpoints.fire(SITE_REPL_ACK)
+                continue
             try:
                 if self._apply_task(task):
                     self.applied += 1
                     scope.inc(m.M_REPL_APPLIED)
+                    key = (task.domain_id, task.workflow_id, task.run_id)
+                    if isinstance(task, ReplicationTask) and key not in seen:
+                        seen.add(key)
+                        touched.append(key)
                 else:
                     self.deduped += 1
                     scope.inc(m.M_REPL_DEDUPED)
@@ -527,11 +762,77 @@ class ReplicationTaskProcessor:
                 scope.inc(m.M_REPL_RESENT)
                 self._resend(task, gap)
             except ReplayError as err:
-                scope.inc(m.M_REPL_DLQ)
-                self.stores.queue.enqueue(REPLICATION_DLQ,
-                                          DLQEntry(task=task, error=str(err)))
+                self._quarantine(task, str(err))
             self.ack_index = index + 1
+            crashpoints.fire(SITE_REPL_ACK)
+        if touched and self.device.enabled():
+            self.device.apply_keys(touched)
         return len(tasks)
+
+    def _install_shipped(self, task: ShippedSnapshotTask, scope) -> None:
+        """Install one shipped snapshot into the standby's store (tentpole
+        2): torn (blob CRC), foreign (format/layout signature), and stale
+        (address no longer prefixes the local history) records are
+        detected, counted, and ignored — never installed."""
+        import zlib
+
+        from . import snapshot as snapshot_mod
+        from .cache import batch_crc
+
+        rec = task.record
+        scope.inc(m.M_REPL_SNAP_SHIPPED)
+        if not snapshot_mod.enabled():
+            return
+        try:
+            if zlib.crc32(rec.state_blob) != rec.blob_crc:
+                scope.inc(m.M_REPL_SNAP_IGNORED_TORN)
+                return
+            if rec.version != snapshot_mod.SNAPSHOT_VERSION:
+                scope.inc(m.M_REPL_SNAP_IGNORED_FOREIGN)
+                return
+            tpu = self.device.tpu
+            if tpu is not None and tuple(rec.layout) != \
+                    snapshot_mod.layout_signature(tpu.layout):
+                scope.inc(m.M_REPL_SNAP_IGNORED_FOREIGN)
+                return
+            # stale check against whatever history the standby holds: a
+            # record covering batches we already store must match their
+            # bytes (the boundary-batch CRC discipline); a record AHEAD of
+            # local history installs fine — the batches it covers are in
+            # flight behind it on the same queue
+            hs = self.stores.history
+            try:
+                total = hs.batch_count(*rec.key)
+            except Exception:
+                total = 0
+            if 0 < rec.batch_count <= total:
+                part = hs.as_history_batches_range(
+                    *rec.key, from_batch=rec.batch_count - 1)
+                if not part or batch_crc(part[0]) != rec.last_batch_crc:
+                    scope.inc(m.M_REPL_SNAP_IGNORED_STALE)
+                    return
+            self.stores.snapshot.put(rec)
+        except Exception:
+            scope.inc(m.M_REPL_SNAP_IGNORED_TORN)
+            return
+        self.snapshots_installed += 1
+        scope.inc(m.M_REPL_SNAP_INSTALLED)
+
+    def _quarantine(self, task, error: str) -> None:
+        """One DLQ entry: counted, depth-gauged, and flight-recorded (the
+        DLQ is the operator's poison-task surface — invisible entries are
+        how replication silently wedges)."""
+        scope = self.metrics.scope(m.SCOPE_REPLICATION)
+        scope.inc(m.M_REPL_DLQ)
+        self.stores.queue.enqueue(REPLICATION_DLQ,
+                                  DLQEntry(task=task, error=error))
+        depth = self.stores.queue.size(REPLICATION_DLQ)
+        scope.gauge(m.M_REPL_DLQ_DEPTH, float(depth))
+        flightrecorder.emit("replication-dlq",
+                            domain=getattr(task, "domain_id", ""),
+                            workflow=getattr(task, "workflow_id", ""),
+                            run=getattr(task, "run_id", ""),
+                            error=error[:200], depth=depth)
 
     def _resend(self, task: ReplicationTask, gap: RetryReplicationError) -> None:
         """Pull the missing range and re-apply (history_resender.go:111).
@@ -541,8 +842,7 @@ class ReplicationTaskProcessor:
         task in the DLQ instead of crashing the pump and wedging the ack
         index on the same task forever."""
         if self.source_history_reader is None:
-            self.stores.queue.enqueue(
-                REPLICATION_DLQ, DLQEntry(task=task, error=str(gap)))
+            self._quarantine(task, str(gap))
             return
         self.resends += 1
         try:
@@ -565,8 +865,7 @@ class ReplicationTaskProcessor:
                 ))
             applied = self._apply_task(task)
         except (RetryReplicationError, ReplayError) as err:
-            self.stores.queue.enqueue(
-                REPLICATION_DLQ, DLQEntry(task=task, error=str(err)))
+            self._quarantine(task, str(err))
             return
         if applied:
             self.applied += 1
@@ -577,6 +876,51 @@ class ReplicationTaskProcessor:
 
     def read_dlq(self) -> List[DLQEntry]:
         return [e for _, e in self.stores.queue.read(REPLICATION_DLQ, 0, 10_000)]
+
+    def dlq_summary(self) -> Dict[str, object]:
+        """The `admin dlq` rollup: depth, the oldest quarantined task, and
+        error classes (the text up to the first ':' — exception-ish
+        prefixes group naturally). Also refreshes the depth gauge, so a
+        scrape after an operator look never reads a stale depth."""
+        entries = self.read_dlq()
+        scope = self.metrics.scope(m.SCOPE_REPLICATION)
+        scope.gauge(m.M_REPL_DLQ_DEPTH, float(len(entries)))
+        classes: Dict[str, int] = {}
+        for e in entries:
+            cls = (e.error or "unknown").split(":", 1)[0].strip()[:80]
+            classes[cls] = classes.get(cls, 0) + 1
+        oldest = None
+        if entries:
+            t = entries[0].task
+            oldest = {"domain_id": getattr(t, "domain_id", ""),
+                      "workflow_id": getattr(t, "workflow_id", ""),
+                      "run_id": getattr(t, "run_id", ""),
+                      "first_event_id": getattr(t, "first_event_id", 0),
+                      "error": entries[0].error[:200]}
+        return {"depth": len(entries), "oldest": oldest,
+                "error_classes": classes}
+
+    def redrive_dlq(self) -> Dict[str, int]:
+        """The `admin dlq` redrive arm: drain the DLQ and re-apply every
+        entry THROUGH THE RESENDER (gaps pull their missing range exactly
+        like the live pump), re-quarantining what still fails — a redrive
+        can only shrink the DLQ or keep it, never wedge the pump."""
+        scope = self.metrics.scope(m.SCOPE_REPLICATION)
+        entries = self.read_dlq()
+        self.stores.queue.purge(REPLICATION_DLQ)
+        for entry in entries:
+            try:
+                self._apply_task(entry.task)
+            except RetryReplicationError as gap:
+                self._resend(entry.task, gap)
+            except ReplayError as err:
+                self._quarantine(entry.task, str(err))
+        remaining = self.stores.queue.size(REPLICATION_DLQ)
+        scope.gauge(m.M_REPL_DLQ_DEPTH, float(remaining))
+        redriven = len(entries) - remaining
+        scope.inc(m.M_REPL_REDRIVEN, redriven)
+        return {"read": len(entries), "redriven": redriven,
+                "requeued": remaining}
 
     def merge_dlq(self) -> int:
         """Retry everything in the DLQ; returns how many now applied."""

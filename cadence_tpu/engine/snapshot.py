@@ -401,6 +401,11 @@ class Snapshotter:
                                  EVERY_EVENTS_ENV,
                                  str(DEFAULT_EVERY_EVENTS))))
         self._lock = threading.Lock()
+        #: snapshot-shipping replication hook (engine/replication.
+        #: ReplicationPublisher.publish_snapshot): called with every
+        #: record this writer persists, so standby regions receive the
+        #: same checksum-gated records the local cold paths hydrate from
+        self.shipper: Optional[callable] = None
         #: per-key appended events since the last snapshot write
         self._since: Dict[tuple, int] = {}
         #: keys the policy should NOT re-probe until every_events more
@@ -551,6 +556,13 @@ class Snapshotter:
             interner=dict(interner),
             layout=layout_signature(self.layout))
         self.stores.snapshot.put(rec)
+        if self.shipper is not None:
+            try:
+                self.shipper(rec)
+            except Exception:
+                # shipping is an optimization for the OTHER region's warm
+                # start; a publish failure must never fail the local write
+                pass
         self._defer(key, reset_counter=True)
         scope = self._scope()
         scope.inc(m.M_SNAP_WRITES)

@@ -37,7 +37,10 @@ def sweep_refresh(stores, route, domain_id: str = None) -> int:
     (not holding the current-run pointer after NDC arbitration) are
     skipped: refreshing them would execute a losing run. Returns the
     number of tasks created."""
-    from .persistence import EntityNotExistsError
+    import time
+
+    from .controller import ShardNotOwnedError
+    from .persistence import EntityNotExistsError, ShardOwnershipLostError
     created = 0
     for d_id, wf_id, run_id in stores.execution.list_executions():
         if domain_id is not None and d_id != domain_id:
@@ -47,7 +50,18 @@ def sweep_refresh(stores, route, domain_id: str = None) -> int:
                 continue
         except EntityNotExistsError:
             continue
-        created += route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+        # promotion sweeps run exactly while shards are changing hands, so
+        # a fenced write (stale ring view on the routed host) is a ROUTINE
+        # transient here, not a failure: the fence rejected the whole
+        # update, and refresh is idempotent, so re-route and retry
+        for attempt in range(8):
+            try:
+                created += route(wf_id).refresh_tasks(d_id, wf_id, run_id)
+                break
+            except (ShardOwnershipLostError, ShardNotOwnedError):
+                if attempt == 7:
+                    raise
+                time.sleep(0.25 * (attempt + 1))
     return created
 
 

@@ -52,6 +52,12 @@ from .durability import (
 )
 from .persistence import Stores
 
+#: queue names that are cross-cluster ACK CURSORS, not local queues:
+#: the consuming cluster persists its resume position into a peer's
+#: stream under these names (rpc/server leader pumps) — a recovered
+#: store legitimately holds the ack with no local queue behind it
+XDC_ACK_PREFIXES = ("repl-from:", "domainrepl-from:", "xc-from:")
+
 #: record fields that only exist from a given schema version on: their
 #: absence under a label at/past that version is the stale-migration
 #: signature, per record type — {type: (since_version, fields)}
@@ -166,9 +172,15 @@ def audit_stores(stores: Stores) -> List[Finding]:
     from ..core.codec import serialize_history
     findings: List[Finding] = []
 
-    # orphaned acks: a resume cursor pointing past the queue's contents
+    # orphaned acks: a resume cursor pointing past the queue's contents.
+    # Cross-cluster cursors are exempt: the consuming cluster stores its
+    # ack under the PEER-scoped name (rpc/server leader pumps) while the
+    # queue tail lives in the peer's store — locally the queue never
+    # exists, by design, and the cursor must survive recovery verbatim.
     sizes, acks = stores.queue.snapshot()
     for (queue, consumer), index in acks.items():
+        if queue.startswith(XDC_ACK_PREFIXES):
+            continue
         if index >= sizes.get(queue, 0):
             findings.append(Finding(
                 "orphaned-ack", f"{queue}/{consumer}",

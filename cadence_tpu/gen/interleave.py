@@ -51,6 +51,7 @@ from ..core.enums import DecisionType, EventType, WorkflowState
 from ..engine import crashpoints
 from ..engine.crashpoints import CrashPoint, SimulatedCrash
 from ..engine.faults import FaultInjector, TransientStoreError, inject_faults
+from ..engine.domain import DomainNotActiveError
 from ..engine.history_engine import Decision, InvalidRequestError
 from ..engine.persistence import (
     EntityNotExistsError,
@@ -820,5 +821,380 @@ def interleave_scenario(seed: int = 20260804, num_workflows: int = 4,
                    and chaotic.kills == chaotic.fsck_clean
                    and (not serving
                         or chaotic.serving_transactions > 0)),
+    }
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Replication-seam fuzz profile: the apply pump vs live standby traffic
+# ---------------------------------------------------------------------------
+
+DOMAIN_R = "rilv-domain"
+TL_R = "rilv-tasklist"
+
+
+def build_replication_schedule(seed: int, num_workflows: int = 4,
+                               length: int = 48,
+                               poisons: int = 2) -> List[dict]:
+    """A seeded schedule over the REPLICATION seam. Phase 1 drives live
+    traffic on the active cluster with incremental apply-pump drains
+    woven between ops (each drain is one queue page — so applies land at
+    arbitrary history offsets, not at quiet barriers); `poisons`
+    semantically-invalid ReplicationTasks are injected at seeded
+    positions. A single mid-schedule `promote` is the split-brain NDC
+    version bump; phase 2 interleaves standby-side live signals/resets
+    with DIVERGENT active-side writes and bidirectional drains. The
+    closing `heal` converges both sides."""
+    rng = random.Random(f"rilv-schedule:{seed}")
+    wfs = [f"rilv-wf-{i}" for i in range(num_workflows)]
+    ops: List[dict] = [{"op": "start", "wf": wf} for wf in wfs]
+    ops.append({"op": "drain"})
+    sig = 0
+    for _ in range(length):
+        wf = rng.choice(wfs)
+        r = rng.random()
+        if r < 0.55:
+            sig += 1
+            ops.append({"op": "signal", "wf": wf, "name": f"ra-{sig}"})
+        elif r < 0.9:
+            ops.append({"op": "drain"})
+        else:
+            sig += 1
+            # the dedup race across the wire: the same signal twice
+            ops.append({"op": "signal", "wf": wf, "name": f"ra-{sig}",
+                        "request_id": f"rrid-{sig}"})
+            ops.append({"op": "signal", "wf": wf, "name": f"ra-{sig}",
+                        "request_id": f"rrid-{sig}"})
+    # poison tasks: seeded interior positions, phase 1 only (version 1)
+    lo = num_workflows + 2
+    for _ in range(poisons):
+        pos = rng.randrange(lo, len(ops))
+        ops.insert(pos, {"op": "poison", "wf": rng.choice(wfs)})
+    ops.append({"op": "promote"})
+    for _ in range(length // 2):
+        wf = rng.choice(wfs)
+        r = rng.random()
+        if r < 0.40:
+            sig += 1
+            ops.append({"op": "s_signal", "wf": wf, "name": f"rs-{sig}"})
+        elif r < 0.55:
+            ops.append({"op": "s_reset", "wf": wf})
+        elif r < 0.70:
+            sig += 1
+            # divergent active-side write: the old active keeps going at
+            # its version — the loser branch NDC must fork and retire
+            ops.append({"op": "signal", "wf": wf, "name": f"rz-{sig}"})
+        else:
+            ops.append({"op": "drain_both"})
+    ops.append({"op": "heal"})
+    return ops
+
+
+class _ReplicationDriver:
+    """Executes one replication-seam schedule against an in-process
+    two-cluster group (`ReplicatedClusters`): the active cluster's live
+    engine, the standby's apply pump (host replicator + device twin),
+    and — after the promote — the standby's OWN live engine writing at
+    the bumped failover version."""
+
+    def __init__(self, seed: int, num_workflows: int = 4) -> None:
+        from ..engine.multicluster import ReplicatedClusters
+        from ..models.deciders import SignalDecider
+
+        self.seed = seed
+        self.clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
+        # the serving tier feeds the seam under test: its post-flush
+        # snapshot policy is what SHIPS records down the stream (the
+        # wired Snapshotter.shipper), seeding the standby's device twin
+        self.clusters.active.enable_serving()
+        self.clusters.standby.enable_serving()
+        self.clusters.register_global_domain(DOMAIN_R)
+        self.wfs = [f"rilv-wf-{i}" for i in range(num_workflows)]
+        # stays open through the whole run (signals land well short of
+        # the close threshold) so every drain applies a LIVE history
+        self.deciders = {wf: SignalDecider(expected_signals=999)
+                         for wf in self.wfs}
+        self.domain_id = self.clusters.active.stores.domain.by_name(
+            DOMAIN_R).domain_id
+        self.poisons_sent = 0
+        self.drains = 0
+        self.promoted = False
+
+    # -- worker loop ---------------------------------------------------------
+
+    def _drive(self, box, rounds: int = 200) -> None:
+        """Bounded poll/decide/pump loop on one box (the taskpoller
+        shape, in-package)."""
+        for _ in range(rounds):
+            progressed = box.pump_once() > 0
+            while True:
+                resp = box.frontend.poll_for_decision_task(DOMAIN_R, TL_R)
+                if resp is None:
+                    break
+                progressed = True
+                if resp.query_only:
+                    for qid, _t, _a in resp.queries:
+                        box.frontend.respond_query_task_completed(
+                            resp.execution, qid, b"rilv")
+                    continue
+                decider = self.deciders[resp.token.workflow_id]
+                try:
+                    box.frontend.respond_decision_task_completed(
+                        resp.token, decider.decide(resp.history))
+                except InvalidRequestError:
+                    pass  # stale token from a reset base run
+                except DomainNotActiveError:
+                    pass  # peer promotion landed on this workflow first
+            if not progressed and box.matching.backlog() == 0:
+                return
+
+    # -- ops -----------------------------------------------------------------
+
+    def _signal(self, box, wf: str, name: str, request_id=None) -> None:
+        try:
+            box.frontend.signal_workflow_execution(
+                DOMAIN_R, wf, name, request_id=request_id)
+        except (EntityNotExistsError, InvalidRequestError):
+            return  # closed by an earlier close — benign
+        except DomainNotActiveError:
+            # the split-brain loser already saw the winner's higher
+            # failover version on this workflow (reverse replication
+            # raced ahead of its domain record): the write is rejected
+            # typed, pre-apply — exactly the arbitration contract
+            return
+        self._drive(box)
+
+    def _poison(self, wf: str) -> None:
+        """Inject one semantically-invalid ReplicationTask: contiguity
+        holds (first_event_id == the standby's expected next) but the
+        batch completes an activity that was never scheduled — the host
+        replicator must raise ReplayError and quarantine to the DLQ,
+        never half-apply. Crafted after a full drain so the poison is at
+        the head of the gap, not deduped behind real traffic."""
+        from ..core.codec import serialize_history
+        from ..core.events import HistoryBatch, HistoryEvent
+        from ..engine.replication import ReplicationTask
+
+        self.clusters.replicate()
+        run_id = self.clusters.standby.stores.execution.get_current_run_id(
+            self.domain_id, wf)
+        ms = self.clusters.standby.stores.execution.get_workflow(
+            self.domain_id, wf, run_id)
+        if ms.execution_info.state == WorkflowState.Completed:
+            return
+        next_id = ms.execution_info.next_event_id
+        bad = HistoryBatch(
+            domain_id=self.domain_id, workflow_id=wf, run_id=run_id,
+            events=[HistoryEvent(
+                id=next_id, event_type=EventType.ActivityTaskCompleted,
+                version=1, timestamp=1,
+                attrs=dict(scheduled_event_id=99990 + self.poisons_sent,
+                           started_event_id=99991))])
+        self.clusters.publisher.stores.queue.enqueue(
+            "replication",
+            ReplicationTask(domain_id=self.domain_id, workflow_id=wf,
+                            run_id=run_id, first_event_id=next_id,
+                            next_event_id=next_id + 1, version=1,
+                            events_blob=serialize_history([bad])))
+        self.poisons_sent += 1
+
+    def _reset_standby(self, wf: str) -> None:
+        """Live reset on the promoted standby: rewind to the second
+        decision boundary when the history has one (the NDC fork + new
+        run id that must replicate back and win)."""
+        box = self.clusters.standby
+        run_id = box.stores.execution.get_current_run_id(self.domain_id, wf)
+        if run_id is None:
+            return
+        events = box.stores.history.read_events(self.domain_id, wf, run_id)
+        starts = [e for e in events
+                  if e.event_type == EventType.DecisionTaskStarted]
+        if len(starts) < 2:
+            return
+        finish_id = starts[1].id + 1
+        if not any(e.id == finish_id
+                   and e.event_type == EventType.DecisionTaskCompleted
+                   for e in events):
+            return
+        try:
+            box.frontend.reset_workflow_execution(
+                DOMAIN_R, wf, decision_finish_event_id=finish_id,
+                reason="rilv-reset")
+        except (EntityNotExistsError, InvalidRequestError):
+            return
+        self._drive(box)
+
+    def _execute(self, item: dict) -> None:
+        op, wf = item["op"], item.get("wf", "")
+        c = self.clusters
+        if op == "start":
+            c.active.frontend.start_workflow_execution(
+                DOMAIN_R, wf, "rilv-type", TL_R)
+            self._drive(c.active)
+        elif op == "signal":
+            self._signal(c.active, wf, item["name"],
+                         request_id=item.get("request_id"))
+        elif op == "s_signal":
+            self._signal(c.standby, wf, item["name"])
+        elif op == "s_reset":
+            self._reset_standby(wf)
+        elif op == "drain":
+            self.drains += 1
+            c.active.serving.drain(timeout=30)  # flushes ship snapshots
+            c.domain_processor.process_once()
+            c.processor.process_once()
+        elif op == "drain_both":
+            self.drains += 1
+            c.active.serving.drain(timeout=30)
+            c.standby.serving.drain(timeout=30)
+            c.processor.process_once()
+            c.reverse_processor.process_once()
+        elif op == "poison":
+            self._poison(wf)
+        elif op == "promote":
+            c.replicate()  # standby forks from a replicated prefix
+            c.split_brain_promote(DOMAIN_R)
+            self.promoted = True
+            self._drive(c.standby)
+        elif op == "heal":
+            c.heal(DOMAIN_R, "standby")
+            self._drive(c.standby)
+            self._drive(c.active)
+            c.active.serving.drain(timeout=30)
+            c.standby.serving.drain(timeout=30)
+            c.replicate()
+            c.replicate_reverse()
+        else:
+            raise ValueError(f"unknown replication schedule op {op!r}")
+
+    def run(self, schedule: List[dict]) -> None:
+        for item in schedule:
+            self._execute(item)
+
+    # -- gates ---------------------------------------------------------------
+
+    def checksums(self, box) -> Dict[str, Tuple[str, int, int]]:
+        """(current run id, canonical payload crc, close status) per
+        workflow — the cross-region byte-identity gate."""
+        out: Dict[str, Tuple[str, int, int]] = {}
+        for wf in self.wfs:
+            run_id = box.stores.execution.get_current_run_id(
+                self.domain_id, wf)
+            ms = box.stores.execution.get_workflow(self.domain_id, wf, run_id)
+            out[wf] = (run_id, int(crc32_of_row(payload_row(ms))),
+                       int(ms.execution_info.close_status))
+        return out
+
+
+def replication_interleave_scenario(seed: int = 20260806,
+                                    num_workflows: int = 4,
+                                    length: int = 48,
+                                    poisons: int = 2) -> dict:
+    """Fuzz the replication seam (ISSUE 17 satellite): one seeded
+    schedule interleaves the standby's apply pump — host replicator +
+    device twin, one queue page at a time — with live active-side
+    traffic, a mid-schedule split-brain promotion (NDC failover-version
+    bump), live signals/resets on the promoted standby racing divergent
+    old-active writes, and seeded poison ReplicationTasks. Gates:
+
+    - after heal, every workflow's (run id, canonical payload checksum,
+      close status) is BYTE-IDENTICAL across both clusters;
+    - the DLQ holds exactly the injected poisons — quarantine is
+      DLQ-only (nothing else quarantined, nothing half-applied) and the
+      reverse direction's DLQ is empty;
+    - the device twin took real bulk applies with zero parity
+      divergence on both registries;
+    - closing verify_all (device bulk replay vs live state) is green on
+      both clusters."""
+    schedule = build_replication_schedule(
+        seed, num_workflows=num_workflows, length=length, poisons=poisons)
+    # fuzz histories are SHORT: tighten the snapshot policy so the
+    # shipping seam actually carries records at this scale (the policy
+    # is read at Snapshotter construction, inside the driver)
+    knobs = {"CADENCE_TPU_SNAPSHOT_MIN_EVENTS": "1",
+             "CADENCE_TPU_SNAPSHOT_EVERY_EVENTS": "4"}
+    saved_env = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        driver = _ReplicationDriver(seed, num_workflows=num_workflows)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        driver.run(schedule)
+        c = driver.clusters
+        c.active.serving.drain(timeout=30)
+        c.standby.serving.drain(timeout=30)
+    finally:
+        for box in (driver.clusters.active, driver.clusters.standby):
+            if box.serving is not None:
+                box.serving.stop()
+
+    active_sums = driver.checksums(c.active)
+    standby_sums = driver.checksums(c.standby)
+    dlq = c.processor.read_dlq()
+    reverse_dlq = c.reverse_processor.read_dlq()
+
+    def _counter(box, scope, name):
+        return int(box.metrics.counter(scope, name))
+
+    device_applied = _counter(c.standby, m.SCOPE_REPLICATION,
+                              m.M_REPL_DEVICE_APPLIED)
+    device_divergence = (
+        _counter(c.standby, m.SCOPE_REPLICATION, m.M_REPL_DEVICE_DIVERGENCE)
+        + _counter(c.active, m.SCOPE_REPLICATION, m.M_REPL_DEVICE_DIVERGENCE))
+    serving_divergence = (
+        _counter(c.standby, m.SCOPE_TPU_SERVING, m.M_SERVING_DIVERGENCE)
+        + _counter(c.active, m.SCOPE_TPU_SERVING, m.M_SERVING_DIVERGENCE))
+    verify_active = c.active.tpu.verify_all()
+    verify_standby = c.standby.tpu.verify_all()
+
+    from ..engine.replication import _DeviceApplier
+    device_expected = _DeviceApplier(c.standby.tpu,
+                                     c.standby.metrics).enabled()
+    identical = active_sums == standby_sums
+    dlq_exact = (len(dlq) == driver.poisons_sent
+                 and len(reverse_dlq) == 0
+                 and all("missing activity" in e.error for e in dlq))
+    doc = {
+        "scenario": "replication-interleave",
+        "seed": seed, "workflows": num_workflows,
+        "schedule_ops": len(schedule),
+        "drains": driver.drains,
+        "promoted": driver.promoted,
+        "poisons_injected": driver.poisons_sent,
+        "dlq_depth": len(dlq),
+        "reverse_dlq_depth": len(reverse_dlq),
+        "dlq_exact": dlq_exact,
+        "active_checksums": active_sums,
+        "standby_checksums": standby_sums,
+        "checksums_identical": identical,
+        "replication": {
+            "applied": c.processor.applied,
+            "deduped": c.processor.deduped,
+            "resends": c.processor.resends,
+            "snapshots_installed": c.processor.snapshots_installed,
+            "device_enabled": device_expected,
+            "device_applied": device_applied,
+            "device_divergence": device_divergence,
+        },
+        "serving_divergence": serving_divergence,
+        "verify": {
+            "active": {"total": verify_active.total,
+                       "divergent": len(verify_active.divergent)},
+            "standby": {"total": verify_standby.total,
+                        "divergent": len(verify_standby.divergent)},
+        },
+        "ok": bool(identical and dlq_exact and driver.promoted
+                   and driver.poisons_sent == poisons
+                   and device_divergence == 0
+                   and serving_divergence == 0
+                   and (not device_expected
+                        or (device_applied > 0
+                            and c.processor.snapshots_installed > 0))
+                   and verify_active.ok and verify_standby.ok),
     }
     return doc

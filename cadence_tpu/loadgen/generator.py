@@ -213,10 +213,16 @@ class LoadGenerator:
                  plans: Sequence[DomainPlan],
                  registry=None, workers: int = 16,
                  longpoll_timeout_s: float = 0.25,
-                 pump: Optional[Callable[[], object]] = None) -> None:
+                 pump: Optional[Callable[[], object]] = None,
+                 request_salt: str = "") -> None:
         if not clients:
             raise ValueError("need at least one client")
         self.clients = list(clients)
+        #: disambiguates signal request-ids across RUNS sharing a pool:
+        #: a replicated pool carries phase-1 request ids in its dedup
+        #: sets, so a post-failover phase against the same pool must salt
+        #: its own ids or its signals silently no-op as "redeliveries"
+        self.request_salt = request_salt
         self.schedule = list(schedule)
         self.plans = list(plans)
         from ..utils.metrics import MetricsRegistry
@@ -423,7 +429,8 @@ class LoadGenerator:
             # of the same scheduled signal dedups server-side
             client.signal_workflow_execution(
                 op.domain, op.workflow_id, op.arg,
-                request_id=f"lg-req-{op.domain}-{op.index}")
+                request_id=(f"lg-req-{self.request_salt}"
+                            f"{op.domain}-{op.index}"))
         elif op.kind == OP_SIGNAL_WITH_START:
             client.signal_with_start_workflow_execution(
                 op.domain, op.workflow_id, op.arg, POOL_TYPE,

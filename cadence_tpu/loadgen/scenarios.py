@@ -23,6 +23,7 @@ deployment divides a domain's global RPS across frontends.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -708,6 +709,362 @@ def cluster_serving_scenario(duration_s: float = 12.0, num_hosts: int = 3,
         and serving_divergence == 0
         and migration_divergence == 0
         and (verify_doc is None or verify_doc["divergent"] == 0))
+    return doc
+
+
+def region_failover_scenario(duration_s: float = 10.0, num_hosts: int = 2,
+                             rps: float = 10.0, pool_size: int = 12,
+                             kill_at_frac: float = 0.6,
+                             seed: int = 20260806,
+                             p99_slo_ms: float = 8000.0,
+                             workers: int = 16, num_shards: int = 8,
+                             hb_interval: float = 0.15, ttl: float = 1.5,
+                             hydration_floor: float = 0.8,
+                             max_repl_lag: int = 64,
+                             verify: bool = True) -> dict:
+    """Active-active multi-region failover under region kill (ISSUE 17's
+    acceptance run): TWO wire regions — each its own WAL-backed store
+    server + N service hosts with the serving tier ON — continuously
+    replicating (history, domain metadata, and shipped snapshot records
+    all ride the replication stream; the standby leader's device applier
+    keeps its HBM state hot at the bulk-ingest rate). Standard-mix
+    traffic drives the active region; mid-window EVERY active-region
+    process is SIGKILLed. The standby then promotes WARM: pre-flip
+    snapshot hydration of its serving tier, domain flip with a failover
+    version bump, task regeneration — and a second traffic phase runs
+    against the promoted region.
+
+    The contract, gated in `doc["ok"]`:
+    - replication lag is bounded at the kill instant (the data-loss
+      window an unplanned region failover can ever cost);
+    - the promoted region's signal p99 (decision-transaction latency,
+      clocked from intended send time) holds its SLO;
+    - the stolen executions are ≥ `hydration_floor` warm at promotion:
+      snapshot-hydrated or already device-resident via the standby's
+      device-speed apply — not a cold replay storm;
+    - zero parity divergence everywhere: both regions' serving tiers,
+      the migration/hydration parity gates, and the replication device
+      applier's own per-apply parity counter;
+    - post-run oracle↔device verify is green on BOTH regions — the
+      promoted one live, the killed one after relaunching its store
+      server from the WAL it crashed with (fsck-clean recovery);
+    - `events_per_sec_fleet` (device-replayed events summed over every
+      host of every region) is recorded next to the per-region
+      `events_per_sec_cluster` north star."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import types
+
+    import cadence_tpu
+
+    from ..engine.failovermanager import FailoverManager
+    from ..engine.multicluster import _refresh_domain_tasks
+    from ..engine.replication import REPLICATION_QUEUE
+    from ..rpc.cluster import _wait_listening, free_port, launch_group
+    from ..utils import metrics as cm
+    from .mixes import (
+        OP_QUERY,
+        OP_SIGNAL,
+        OP_SIGNAL_WITH_START,
+        OP_START,
+        ScheduledOp,
+        TrafficMix,
+    )
+
+    env_extra = {
+        "CADENCE_TPU_SERVING": "1",
+        # aggressive snapshot policy: every parity-clean append refreshes
+        # the local store AND ships the record to the peer region, so the
+        # kill can land anywhere and the standby still hydrates warm
+        "CADENCE_TPU_SNAPSHOT_MIN_EVENTS": "1",
+        "CADENCE_TPU_SNAPSHOT_EVERY_EVENTS": "1",
+        "CADENCE_TPU_SERVING_BATCH": "8",
+        "CADENCE_TPU_SERVING_WARM_EVENTS": "16,32,64",
+    }
+    domain = "lg-region"
+    plans = [DomainPlan(domain, rps, mix=STANDARD_MIX,
+                        pool_size=pool_size)]
+    schedule = build_schedule(plans, duration_s, seed)
+    # promoted-phase traffic against the STOLEN pool: signal-dominant
+    # (decision transactions on the hydrated rows), a start tail for
+    # post-failover admits — no resets (their compile warm-up belongs to
+    # prepare, which phase 2 deliberately skips: the pool it drives is
+    # the replicated one, not a freshly seeded one)
+    mix2 = TrafficMix("region-promoted", {OP_SIGNAL: 0.5, OP_START: 0.2,
+                                          OP_QUERY: 0.2,
+                                          OP_SIGNAL_WITH_START: 0.1})
+    plans2 = [DomainPlan(domain, rps, mix=mix2, pool_size=pool_size)]
+    schedule2 = [
+        # churn start ids restart phase-1's replicated churn ids unless
+        # salted; pool/sws/query ids must NOT be salted (the stolen pool
+        # is the point)
+        ScheduledOp(index=op.index, at_s=op.at_s, kind=op.kind,
+                    domain=op.domain,
+                    workflow_id=(f"p2-{op.workflow_id}"
+                                 if op.kind == OP_START
+                                 else op.workflow_id), arg=op.arg)
+        for op in build_schedule(plans2, duration_s, seed + 1)]
+
+    wal_dir = tempfile.mkdtemp(prefix="cadence-region-")
+    group = launch_group(("primary", "standby"), num_hosts=num_hosts,
+                         num_shards=num_shards, hb_interval=hb_interval,
+                         ttl=ttl, env_extra=env_extra, wal_dir=wal_dir)
+    pcluster = group.clusters["primary"]
+    scluster = group.clusters["standby"]
+    primary_hosts = sorted(pcluster.hosts)
+    standby_hosts = sorted(scluster.hosts)
+    kill_scrape_primary: Dict[str, dict] = {}
+    lag_doc = {"lag": -1, "tail": 0}
+    recover_proc = None
+    try:
+        # hold traffic until every host in BOTH regions is serving-warm
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            docs = []
+            for cl in (pcluster, scluster):
+                for n in sorted(cl.hosts):
+                    try:
+                        docs.append(cl.admin(n, "admin_cluster"))
+                    except Exception:
+                        pass
+            if (len(docs) == len(pcluster.hosts) + len(scluster.hosts)
+                    and all(d.get("serving_warmed") for d in docs)):
+                break
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("serving tier never warmed in both regions")
+        group.register_global_domain(domain)
+
+        clients = [pcluster.frontend(n) for n in primary_hosts]
+        gen = LoadGenerator(clients, schedule, plans, workers=workers)
+        gen.prepare(setup_deadline_s=120.0)
+        # let the seeded pool replicate before the measured window so the
+        # kill-time lag number reflects steady-state streaming, not the
+        # prepare burst
+        group.replicate()
+        counter = {"n": 0}
+
+        def completer_client():
+            counter["n"] += 1
+            return pcluster.frontend(
+                primary_hosts[counter["n"] % len(primary_hosts)])
+
+        completers = DecisionCompleters(completer_client, [domain])
+        completers.start()
+        start_scrape_primary = _host_metrics(pcluster)
+        start_scrape_standby = _host_metrics(scluster)
+        t_fleet0 = time.monotonic()
+
+        def killer():
+            time.sleep(max(0.1, duration_s * kill_at_frac))
+            # the pre-kill lag gate: bounded wait for the stream to be
+            # caught up (traffic still flowing), then record the honest
+            # number — this is the data-loss window the kill can cost
+            lag_deadline = time.monotonic() + 15.0
+            while True:
+                try:
+                    tail = group.active.stores.queue.size(REPLICATION_QUEUE)
+                    ack = group.standby.stores.queue.get_ack(
+                        "repl-from:primary", "standby")
+                    lag_doc["lag"], lag_doc["tail"] = max(0, tail - ack), tail
+                except Exception:
+                    pass
+                if (0 <= lag_doc["lag"] <= max_repl_lag
+                        or time.monotonic() > lag_deadline):
+                    break
+                time.sleep(0.2)
+            kill_scrape_primary.update(_host_metrics(pcluster))
+            # kill -9 EVERY active-region process: serving plane first,
+            # then the region's store itself
+            for name in primary_hosts:
+                try:
+                    pcluster.kill_host(name)
+                except Exception:
+                    pass
+            try:
+                pcluster.store_proc.kill()
+                pcluster.store_proc.wait(timeout=10)
+            except Exception:
+                pass
+            # the remaining phase-1 schedule has no region to land on:
+            # abort so the workers stop burning retry backoff against a
+            # dead region (their in-flight errors are already recorded)
+            gen.abort()
+
+        kill_thread = threading.Thread(target=killer, daemon=True)
+        kill_thread.start()
+        load1 = gen.run()
+        kill_thread.join(timeout=60)
+        completers.stop()
+        load1.completed_churn = completers.completed
+
+        # -- warm promotion: pre-flip hydration, then flip + regenerate --
+        t_promote0 = time.monotonic()
+        fm = FailoverManager(group)
+        prehydration = fm._prehydrate(group.standby) or {}
+        group.standby.frontend.update_domain(domain,
+                                             active_cluster="standby")
+        _refresh_domain_tasks(group.standby, domain)
+        promote_s = time.monotonic() - t_promote0
+
+        clients2 = [scluster.frontend(n) for n in standby_hosts]
+        gen2 = LoadGenerator(clients2, schedule2, plans2, workers=workers,
+                             request_salt="p2-")
+        counter2 = {"n": 0}
+
+        def completer_client2():
+            counter2["n"] += 1
+            return scluster.frontend(
+                standby_hosts[counter2["n"] % len(standby_hosts)])
+
+        completers2 = DecisionCompleters(completer_client2, [domain])
+        completers2.start()
+        load2 = gen2.run()
+        drain_deadline = time.monotonic() + max(5.0, ttl * 4)
+        last = -1
+        while time.monotonic() < drain_deadline:
+            time.sleep(0.5)
+            if completers2.completed == last:
+                break
+            last = completers2.completed
+        completers2.stop()
+        load2.completed_churn = completers2.completed
+        window = max(2 * duration_s, time.monotonic() - t_fleet0)
+
+        end_scrape_standby = _host_metrics(scluster)
+        verify_standby = (_verify_cluster_state(scluster)
+                          if verify else None)
+
+        # -- the killed region comes back: relaunch its store from the
+        # WAL it crashed with (recover_stores fsck runs inside the store
+        # server) and verify oracle↔device over the recovered state
+        verify_primary = None
+        if verify:
+            rport = free_port()
+            renv = dict(os.environ)
+            renv.setdefault("JAX_PLATFORMS", "cpu")
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(cadence_tpu.__file__)))
+            renv["PYTHONPATH"] = repo + os.pathsep + renv.get(
+                "PYTHONPATH", "")
+            recover_proc = subprocess.Popen(
+                [sys.executable, "-m", "cadence_tpu.rpc.storeserver",
+                 "--port", str(rport), "--wal", pcluster.wal], env=renv)
+            _wait_listening(rport, recover_proc)
+            verify_primary = _verify_cluster_state(
+                types.SimpleNamespace(store_port=rport))
+    finally:
+        if recover_proc is not None and recover_proc.poll() is None:
+            recover_proc.kill()
+            recover_proc.wait(timeout=10)
+        group.stop()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+    # -- warm-promotion accounting: a stolen execution is warm when its
+    # HBM state was snapshot-hydrated at the flip OR already resident via
+    # the standby's device-speed apply; young (sub-snapshot-floor)
+    # histories are reported, not charged (same convention as
+    # cluster_serving_scenario)
+    warm = (prehydration.get("hydrated", 0)
+            + prehydration.get("already_resident", 0))
+    cold = prehydration.get("cold", 0) + prehydration.get("stale", 0)
+    steals = warm + cold
+    hydration_ratio = (warm / steals) if steals > 0 else 0.0
+
+    def _life_sum(scope, metric):
+        """Whole-life counter: standby over its life + primary pre-kill."""
+        return (_counter_delta(end_scrape_standby, {}, scope, metric)
+                + _counter_delta(kill_scrape_primary, {}, scope, metric))
+
+    serving_divergence = _life_sum(cm.SCOPE_TPU_SERVING,
+                                   cm.M_SERVING_DIVERGENCE)
+    migration_divergence = _life_sum(cm.SCOPE_TPU_MIGRATION,
+                                     cm.M_MIG_DIVERGENCE)
+    repl_device_divergence = _life_sum(cm.SCOPE_REPLICATION,
+                                       cm.M_REPL_DEVICE_DIVERGENCE)
+    snapshots_installed = _counter_delta(end_scrape_standby, {},
+                                         cm.SCOPE_REPLICATION,
+                                         cm.M_REPL_SNAP_INSTALLED)
+    device_applied = _counter_delta(end_scrape_standby, {},
+                                    cm.SCOPE_REPLICATION,
+                                    cm.M_REPL_DEVICE_APPLIED)
+
+    def events_of(scrapes, base, hosts):
+        return (_counter_delta(scrapes, base, cm.SCOPE_TPU_RESIDENT,
+                               cm.M_RESIDENT_EVENTS_APPENDED, hosts=hosts)
+                + _counter_delta(scrapes, base, cm.SCOPE_TPU_REPLAY,
+                                 cm.M_EVENTS_REPLAYED, hosts=hosts))
+
+    events_primary = events_of(kill_scrape_primary, start_scrape_primary,
+                               set(primary_hosts))
+    events_standby = events_of(end_scrape_standby, start_scrape_standby,
+                               set(standby_hosts))
+    events_fleet = events_primary + events_standby
+
+    pct2 = load2.percentiles(OP_SIGNAL)
+    slos = [SLO(domain=domain, p99_ms=p99_slo_ms, max_error_rate=0.2)]
+    slo_report = evaluate_slos(load2, slos)
+    lag_bounded = 0 <= lag_doc["lag"] <= max_repl_lag
+
+    doc = {
+        "scenario": "region-failover",
+        "run": {"duration_s": duration_s, "num_hosts": num_hosts,
+                "num_shards": num_shards, "rps": rps,
+                "pool_size": pool_size, "seed": seed,
+                "kill_at_frac": kill_at_frac, "ttl": ttl,
+                "workers": workers, "hydration_floor": hydration_floor,
+                "max_repl_lag": max_repl_lag,
+                "regions": {"primary": primary_hosts,
+                            "standby": standby_hosts}},
+        "traffic": {"active_phase": load1.as_dict(),
+                    "promoted_phase": load2.as_dict()},
+        "latency": {"promoted_signal_p50_ms": round(pct2["p50"] * 1000, 3),
+                    "promoted_signal_p99_ms": round(pct2["p99"] * 1000, 3)},
+        "slo": slo_report.as_dict(),
+        "replication": {
+            "lag_at_kill": lag_doc["lag"],
+            "queue_tail_at_kill": lag_doc["tail"],
+            "lag_bounded": lag_bounded,
+            "snapshots_installed": snapshots_installed,
+            "device_applied": device_applied,
+        },
+        "failover": {
+            "promote_s": round(promote_s, 3),
+            "prehydration": prehydration,
+            "warm_steals": warm, "cold_steals": cold,
+            "young_steals": prehydration.get("young", 0),
+            "hydration_ratio": round(hydration_ratio, 4),
+        },
+        "parity": {
+            "serving_divergence": serving_divergence,
+            "migration_divergence": migration_divergence,
+            "replication_device_divergence": repl_device_divergence,
+        },
+        "north_star": {
+            "events_per_sec_fleet": round(events_fleet / window, 1),
+            "events_per_sec_cluster": round(events_standby / window, 1),
+            "events_per_sec_cluster_killed_region": round(
+                events_primary / window, 1),
+            "events_replayed_fleet": events_fleet,
+            "window_s": round(window, 3),
+        },
+        "verify": {"promoted_region": verify_standby,
+                   "killed_region_recovered": verify_primary},
+    }
+    doc["ok"] = bool(
+        slo_report.ok
+        and lag_bounded
+        and steals > 0
+        and hydration_ratio >= hydration_floor
+        and snapshots_installed > 0
+        and serving_divergence == 0
+        and migration_divergence == 0
+        and repl_device_divergence == 0
+        and (verify_standby is None or verify_standby["divergent"] == 0)
+        and (verify_primary is None or verify_primary["divergent"] == 0))
     return doc
 
 

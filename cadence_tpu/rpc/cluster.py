@@ -99,8 +99,11 @@ class Cluster:
                  procs: Dict[str, subprocess.Popen],
                  store_proc: subprocess.Popen,
                  http_ports: Dict[str, int] = None,
-                 spawn_host=None) -> None:
+                 spawn_host=None, wal: str = "") -> None:
         self.store_port = store_port
+        #: WAL path of the store server ("" = in-memory): a killed
+        #: region's store can relaunch from it for post-mortem recovery
+        self.wal = wal
         self.hosts = hosts          # name → port
         self.procs = procs          # name → process
         self.store_proc = store_proc
@@ -350,7 +353,7 @@ def _role_env(env_extra, env_per_role, role: str, generic: str):
 def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
                  num_shards: int = 8, hb_interval: float = 0.15,
                  ttl: float = 3.0, env_extra=None,
-                 env_per_role=None) -> ClusterGroup:
+                 env_per_role=None, wal_dir: str = "") -> ClusterGroup:
     """Launch a multi-cluster group: per cluster one store server + N
     service hosts, every host configured with the peer clusters' store
     addresses (the cluster-group config) so its leader runs the inbound
@@ -358,7 +361,10 @@ def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
 
     `env_extra` lands in EVERY spawned process; `env_per_role` overlays
     it per role: keys are "store", "host", or an exact process name —
-    here host names carry the cluster prefix ("primary-host-0")."""
+    here host names carry the cluster prefix ("primary-host-0").
+    `wal_dir` gives each region's store server a WAL under it (one file
+    per cluster name) — the region-failover scenario relaunches a
+    kill -9'd region's store from its WAL for post-mortem verification."""
     store_ports = {name: free_port() for name in cluster_names}
     clusters: Dict[str, Cluster] = {}
     try:
@@ -369,6 +375,8 @@ def launch_group(cluster_names=("primary", "standby"), num_hosts: int = 2,
                 num_hosts=num_hosts, num_shards=num_shards,
                 hb_interval=hb_interval, ttl=ttl, cluster_name=name,
                 store_port=store_ports[name], peer_specs=peers,
+                wal=(os.path.join(wal_dir, f"{name}-store.wal")
+                     if wal_dir else ""),
                 env_extra=env_extra, env_per_role=env_per_role)
     except Exception:
         for c in clusters.values():
@@ -453,4 +461,4 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
             break
         time.sleep(0.05)
     return Cluster(store_port, hosts, procs, store_proc,
-                   http_ports=http_ports, spawn_host=spawn_host)
+                   http_ports=http_ports, spawn_host=spawn_host, wal=wal)

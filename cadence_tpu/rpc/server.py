@@ -488,11 +488,38 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         )
         from .client import RemoteCluster
 
-        self._publisher_holder["pub"] = ReplicationPublisher(self.stores)
+        pub = ReplicationPublisher(self.stores)
+        self._publisher_holder["pub"] = pub
         self.frontend.domain_replication_publisher = (
             DomainReplicationPublisher(self.stores))
         self.processors.cross_cluster_publisher = (
             CrossClusterPublisher(self.stores))
+        # snapshot-shipping replication: every record this host's
+        # post-append policy writes also rides the outbound replication
+        # stream, so standby regions keep warm hydration sources without
+        # ever replaying full histories (tentpole 2, ROADMAP item 2)
+        if self.tpu is not None:
+            cluster = self.cluster_name
+            self.tpu.snapshotter().shipper = (
+                lambda rec: pub.publish_snapshot(rec, cluster))
+        # replication series pre-registered (replication.task-processor/*):
+        # the device-parity divergence counter and the DLQ depth gauge in
+        # particular must ALWAYS scrape — "zero divergence" and "series
+        # missing" must be distinguishable (same contract as tpu.serving)
+        from ..utils import metrics as cm
+        for metric in (cm.M_REPL_APPLIED, cm.M_REPL_DEDUPED,
+                       cm.M_REPL_RESENT, cm.M_REPL_DLQ, cm.M_REPL_REDRIVEN,
+                       cm.M_REPL_DEVICE_APPLIED,
+                       cm.M_REPL_DEVICE_SUFFIX_EVENTS,
+                       cm.M_REPL_DEVICE_COLD, cm.M_REPL_DEVICE_STALE,
+                       cm.M_REPL_DEVICE_DIVERGENCE,
+                       cm.M_REPL_DEVICE_UNSTABLE,
+                       cm.M_REPL_SNAP_SHIPPED, cm.M_REPL_SNAP_INSTALLED,
+                       cm.M_REPL_SNAP_IGNORED_TORN,
+                       cm.M_REPL_SNAP_IGNORED_STALE,
+                       cm.M_REPL_SNAP_IGNORED_FOREIGN):
+            self.metrics.inc(cm.SCOPE_REPLICATION, metric, 0)
+        self.metrics.gauge(cm.SCOPE_REPLICATION, cm.M_REPL_DLQ_DEPTH, 0.0)
 
         for peer_name, store_addr in self.peers.items():
             peer = RemoteCluster(store_addr, peer_ttl=self.ttl,
@@ -510,7 +537,8 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             repl = ReplicationTaskProcessor(
                 HistoryReplicator(self.stores),
                 ReplicationPublisher(peer.stores), self.stores,
-                source_history_reader=read_peer_history)
+                source_history_reader=read_peer_history,
+                tpu=self.tpu)
             repl.metrics = self.metrics
             domain = DomainReplicationProcessor(peer.stores, self.stores,
                                                 self.cluster_name)
@@ -539,6 +567,17 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             from ..utils.log import DEFAULT_LOGGER
             DEFAULT_LOGGER.error("promotion task refresh failed",
                                  component="xdc", domain=task.name)
+        # warm promotion: hydrate THIS host's shards from shipped
+        # snapshots so the first post-flip transactions land on resident
+        # rows (peers hydrate via the admin_prehydrate wire op — only
+        # the leader sees the replicated flip)
+        if self.migration is not None:
+            try:
+                self.migration.hydrate_shards(self.controller.owned_shards())
+            except Exception:
+                from ..utils.log import DEFAULT_LOGGER
+                DEFAULT_LOGGER.error("promotion hydration failed",
+                                     component="xdc", domain=task.name)
 
     def _pump_xdc(self) -> None:
         """One inbound-replication tick. Leader-gated: the host owning
@@ -891,6 +930,44 @@ class _Handler(socketserver.BaseRequestHandler):
             result = {"shards": rep.shards, "considered": rep.considered,
                       "snapshotted": rep.snapshotted,
                       "skipped": rep.skipped, "evicted": rep.evicted}
+        elif op == "admin_prehydrate":
+            # warm-promotion hydration (the `load region` scenario's
+            # per-host leg): only the leader host sees the replicated
+            # domain flip, so every standby host exposes hydration as a
+            # wire op — seed_caches + suffix replay over its OWN shards
+            if server.migration is None:
+                raise RuntimeError("serving tier (and migration) not "
+                                   "enabled on this host")
+            rep = server.migration.hydrate_shards(
+                server.controller.owned_shards())
+            result = {"shards": rep.shards, "considered": rep.considered,
+                      "hydrated": rep.hydrated,
+                      "suffix_events": rep.suffix_events,
+                      "cold": rep.cold, "young": rep.young,
+                      "stale": rep.stale,
+                      "already_resident": rep.already_resident,
+                      "parity_divergence": rep.parity_divergence}
+        elif op == "admin_dlq":
+            # DLQ rollup / redrive over the wire (the `admin dlq` and
+            # `dlq redrive` CLI verbs' wire legs). Consumers live on the
+            # leader host; a non-leader still answers with a read-only
+            # processor over its cluster's shared stores
+            sub = req[1] if len(req) > 1 else "summary"
+            if server._xdc_consumers:
+                proc = server._xdc_consumers[0].repl
+            else:
+                from ..engine.replication import (
+                    HistoryReplicator as _HR,
+                    ReplicationPublisher as _RP,
+                    ReplicationTaskProcessor as _RTP,
+                )
+                proc = _RTP(_HR(server.stores), _RP(server.stores),
+                            server.stores)
+                proc.metrics = server.metrics
+            if sub == "redrive":
+                result = proc.redrive_dlq()
+            else:
+                result = proc.dlq_summary()
         elif op == "admin_timeseries":
             # the /timeseries doc over the wire (operator tooling that
             # already speaks the protocol need not open the HTTP port)

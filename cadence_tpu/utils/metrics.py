@@ -114,10 +114,36 @@ M_ERRORS = "errors"
 M_LATENCY = "latency"
 M_TASKS_PROCESSED = "tasks-processed"
 M_TASKS_DROPPED_NOT_EXISTS = "tasks-dropped-entity-not-exists"
+#: executor dropped a task whose workflow a PEER cluster's promotion
+#: already owns (version arbitration rejected the local mutation)
+M_TASKS_DROPPED_STALE = "tasks-dropped-stale-version"
 M_REPL_APPLIED = "replication-applied"
 M_REPL_DEDUPED = "replication-deduped"
 M_REPL_RESENT = "replication-resends"
 M_REPL_DLQ = "replication-dlq"
+#: replication DLQ depth gauge: current quarantined-entry count on the
+#: target store (maintained at every enqueue/redrive/purge touch point)
+M_REPL_DLQ_DEPTH = "dlq-depth"
+#: DLQ redrive: entries re-applied through the resender by the
+#: `admin dlq` redrive arm / processor.redrive_dlq
+M_REPL_REDRIVEN = "replication-redriven"
+#: device standby apply (engine/replication.py _DeviceApplier): applied
+#: histories streamed through the resident tier at the bulk-ingest rate,
+#: host-parity gated per apply — divergence counted, never served
+M_REPL_DEVICE_APPLIED = "device-applied"
+M_REPL_DEVICE_SUFFIX_EVENTS = "device-suffix-events"
+M_REPL_DEVICE_COLD = "device-skipped-cold"
+M_REPL_DEVICE_STALE = "device-skipped-stale"
+M_REPL_DEVICE_DIVERGENCE = "device-parity-divergence"
+M_REPL_DEVICE_UNSTABLE = "device-parity-skipped-unstable"
+#: snapshot-shipping replication: checksum-gated SnapshotRecords riding
+#: the wire replication stream so a standby's cold admits and promotion
+#: are seed_caches + suffix replay, never full replay
+M_REPL_SNAP_SHIPPED = "snapshots-shipped"
+M_REPL_SNAP_INSTALLED = "snapshots-installed"
+M_REPL_SNAP_IGNORED_TORN = "snapshots-ignored-torn"
+M_REPL_SNAP_IGNORED_STALE = "snapshots-ignored-stale"
+M_REPL_SNAP_IGNORED_FOREIGN = "snapshots-ignored-foreign"
 M_KERNEL_LAUNCHES = "kernel-launches"
 M_EVENTS_REPLAYED = "events-replayed"
 M_REPLAY_THROUGHPUT = "replay-events-per-sec"

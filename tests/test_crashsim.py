@@ -540,3 +540,177 @@ class TestPurgeAckRecovery:
         assert recovered.queue.read(
             "dlq", recovered.queue.get_ack("dlq", "worker"))
         _zero_findings(wal, recovered)
+
+
+class TestReplicationCrash:
+    """ISSUE 17 satellite: the standby apply pump's crash seams
+    (repl.apply fires before a task applies, repl.ack after its ack
+    advances) — a death at either point must never double-apply a batch
+    on redelivery and never lose a durably-acked position."""
+
+    def _clusters(self, standby_stores=None):
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+        clusters = ReplicatedClusters(num_hosts=1, num_shards=2,
+                                      standby_stores=standby_stores)
+        clusters.register_global_domain(DOMAIN)
+        clusters.active.frontend.start_workflow_execution(
+            DOMAIN, "rc-wf", "t", TL)
+        for name in ("a", "b", "c"):
+            clusters.active.frontend.signal_workflow_execution(
+                DOMAIN, "rc-wf", name, request_id=f"rc-{name}")
+        return clusters
+
+    @staticmethod
+    def _events(box, domain_id, run_id):
+        return [(e.id, e.event_type, e.version)
+                for e in box.stores.history.read_events(
+                    domain_id, "rc-wf", run_id)]
+
+    def test_crash_before_apply_then_retry_applies_once(self):
+        from cadence_tpu.core.checksum import payload_row
+        from cadence_tpu.engine.replication import SITE_REPL_APPLY
+
+        clusters = self._clusters()
+        crashpoints.install(CrashPoint(site=SITE_REPL_APPLY, hit=2,
+                                       mode="raise"))
+        try:
+            with pytest.raises(SimulatedCrash):
+                clusters.replicate()
+        finally:
+            crashpoints.uninstall()
+        clusters.replicate()  # the restarted pump resumes from its ack
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "rc-wf")
+        a = self._events(clusters.active, domain_id, run_id)
+        s = self._events(clusters.standby, domain_id, run_id)
+        assert a == s  # once each — no duplicate, no hole
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "rc-wf", run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "rc-wf", run_id)
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+
+    def test_crash_at_ack_then_full_redelivery_dedups(self):
+        """Death AFTER applies but before the ack persisted: the
+        restarted pump re-reads from the stale ack and redelivers — the
+        replicator's first_event_id dedup must swallow every duplicate
+        without touching history."""
+        from cadence_tpu.core.checksum import payload_row
+        from cadence_tpu.engine.replication import (
+            SITE_REPL_ACK,
+            ReplicationTaskProcessor,
+        )
+
+        clusters = self._clusters()
+        crashpoints.install(CrashPoint(site=SITE_REPL_ACK, hit=3,
+                                       mode="raise"))
+        try:
+            with pytest.raises(SimulatedCrash):
+                clusters.replicate()
+        finally:
+            crashpoints.uninstall()
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "rc-wf")
+        before = self._events(clusters.standby, domain_id, run_id)
+        assert before  # some prefix really applied before the death
+        # restarted pump: fresh processor whose ack position is the
+        # PRE-CRASH level (the in-memory ack died with the process)
+        restarted = ReplicationTaskProcessor(
+            clusters.replicator, clusters.publisher,
+            clusters.standby.stores,
+            source_history_reader=clusters._read_source_history,
+            tpu=clusters.standby.tpu)
+        restarted.metrics = clusters.standby.metrics
+        while restarted.process_once():
+            pass
+        assert restarted.deduped > 0  # the redelivered prefix
+        a = self._events(clusters.active, domain_id, run_id)
+        s = self._events(clusters.standby, domain_id, run_id)
+        assert a == s
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "rc-wf", run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "rc-wf", run_id)
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+
+    def test_standby_wal_restores_ack_and_state(self, wal):
+        """The durable seat: a standby on a WAL persists its applied
+        state AND its consumer ack ('qa' records); recovery restores
+        both, so the resumed pump neither re-applies nor skips."""
+        clusters = self._clusters(standby_stores=open_durable_stores(wal))
+        clusters.replicate()
+        ack = clusters.processor.ack_index
+        assert ack > 0
+        # the wire pump's ack persistence (rpc/server._pump_xdc shape):
+        # set_ack takes the LAST processed index; get_ack hands back the
+        # next-to-read position
+        clusters.standby.stores.queue.set_ack("repl-from:primary",
+                                              "standby", ack - 1)
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "rc-wf")
+        live = self._events(clusters.standby, domain_id, run_id)
+        clusters.standby.stores.wal.close()
+
+        recovered, report = recover_stores(wal, verify_on_device=False,
+                                           rebuild_on_device=False)
+        assert report.ok
+        assert recovered.queue.get_ack("repl-from:primary",
+                                       "standby") == ack
+        rec_events = [(e.id, e.event_type, e.version)
+                      for e in recovered.history.read_events(
+                          domain_id, "rc-wf", run_id)]
+        assert rec_events == live
+        _zero_findings(wal, recovered)
+
+    def test_dlq_and_shipped_snapshot_survive_recovery(self, wal):
+        """Queue payload durability for the two ISSUE 17 record kinds:
+        a quarantined DLQEntry and a shipped SnapshotRecord ('snapship')
+        round-trip the WAL byte-intact on both backends."""
+        import numpy as np
+
+        from cadence_tpu.engine.replication import (
+            REPLICATION_DLQ,
+            DLQEntry,
+            ReplicationPublisher,
+            ReplicationTask,
+        )
+        from cadence_tpu.engine.snapshot import SnapshotRecord
+
+        stores = open_durable_stores(wal)
+        poison = ReplicationTask(
+            domain_id="d1", workflow_id="w1", run_id="r1",
+            first_event_id=5, next_event_id=7, version=3,
+            events_blob=b"\x00corrupt\xff")
+        stores.queue.enqueue(REPLICATION_DLQ,
+                             DLQEntry(task=poison, error="missing activity"))
+        rec = SnapshotRecord(
+            key=("d1", "w1", "r1"), batch_count=2, last_batch_crc=1234,
+            events=9, history_size=512, branch=0,
+            payload=np.arange(6, dtype=np.int64),
+            state_blob=b"state-bytes",
+            blob_crc=__import__("zlib").crc32(b"state-bytes"),
+            interner={"sig": 4}, layout=(1, 2, 3))
+        ReplicationPublisher(stores).publish_snapshot(rec, "primary")
+        stores.wal.close()
+
+        recovered, report = recover_stores(wal, verify_on_device=False,
+                                           rebuild_on_device=False)
+        assert report.ok
+        dlq = [e for _, e in recovered.queue.read(REPLICATION_DLQ, 0, 10)]
+        assert len(dlq) == 1 and dlq[0].error == "missing activity"
+        assert dlq[0].task.events_blob == poison.events_blob
+        assert dlq[0].task.first_event_id == 5
+        shipped = [t for _, t in recovered.queue.read("replication", 0, 10)]
+        assert len(shipped) == 1
+        got = shipped[0].record
+        assert got.key == rec.key and got.batch_count == 2
+        assert got.blob_crc == rec.blob_crc
+        assert got.state_blob == rec.state_blob
+        assert (np.asarray(got.payload) == rec.payload).all()
+        assert got.interner == {"sig": 4}
+        assert tuple(got.layout) == (1, 2, 3)
+        assert shipped[0].source_cluster == "primary"
+        _zero_findings(wal, recovered)

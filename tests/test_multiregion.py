@@ -1,0 +1,167 @@
+"""Active-active multi-region (ISSUE 17): warm failover end to end.
+
+The in-process tests gate the managed-failover coordinator's new warm
+path at tier-1 size: snapshot-shipping replication keeps the standby's
+snapshot store fresh, promotion pre-hydrates the serving tier from it
+BEFORE the active flip (warm steals, parity gated), and the bounded
+replication drain degrades to NDC conflict resolution instead of
+blocking. The slow/load tier runs the full two-region wire scenario —
+standard-mix traffic, kill -9 of every active-region process
+mid-traffic, warm standby promotion under SLO — the repo's analog of a
+region evacuation drill."""
+import pytest
+
+from cadence_tpu.core.checksum import payload_row
+from cadence_tpu.engine.failovermanager import FailoverManager
+from cadence_tpu.engine.multicluster import ReplicatedClusters
+from cadence_tpu.models.deciders import SignalDecider
+from cadence_tpu.utils import metrics as m
+from tests.taskpoller import TaskPoller
+
+DOMAIN = "mr-domain"
+TL = "mr-tasklist"
+
+
+@pytest.fixture()
+def warm_clusters(monkeypatch):
+    """Two regions with live traffic replicated AND snapshot-shipped:
+    the standby's snapshot store is warm, its serving tier is not (yet)."""
+    monkeypatch.setenv("CADENCE_TPU_SNAPSHOT_MIN_EVENTS", "1")
+    monkeypatch.setenv("CADENCE_TPU_SNAPSHOT_EVERY_EVENTS", "4")
+    clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
+    clusters.active.enable_serving()
+    clusters.register_global_domain(DOMAIN)
+    deciders = {}
+    poller = TaskPoller(clusters.active, DOMAIN, TL, deciders)
+    for i in range(3):
+        wf = f"mr-wf-{i}"
+        deciders[wf] = SignalDecider(expected_signals=99)
+        clusters.active.frontend.start_workflow_execution(
+            DOMAIN, wf, "signal", TL)
+        poller.drain()
+        for s in range(2):
+            clusters.active.frontend.signal_workflow_execution(
+                DOMAIN, wf, f"{wf}-s{s}")
+        poller.drain()
+    clusters.active.serving.drain(timeout=30)
+    # deploy warm-up sweep: every resident row snapshots and SHIPS
+    assert clusters.active.tpu.snapshotter().sweep(force=True).written >= 3
+    clusters.replicate()
+    assert clusters.processor.snapshots_installed >= 3
+    yield clusters
+    clusters.active.serving.stop()
+
+
+class TestWarmPromotion:
+    def test_managed_failover_prehydrates_before_flip(self, warm_clusters):
+        clusters = warm_clusters
+        fm = FailoverManager(clusters)
+        report = fm.managed_failover([DOMAIN], to_cluster="standby")
+        assert report.ok and report.succeeded == 1
+        assert report.drain_degraded == 0
+        # the pre-flip hydration pass seeded the promoting serving tier
+        # from the shipped snapshots — warm, not cold
+        hyd = report.prehydration
+        assert hyd is not None
+        assert hyd["hydrated"] + hyd["already_resident"] >= 3
+        assert hyd["parity_divergence"] == 0
+        # post-flip: both sides agree, the standby is authoritative
+        for box in (clusters.active, clusters.standby):
+            assert box.stores.domain.by_name(
+                DOMAIN).active_cluster == "standby"
+        # the hydrated rows are genuinely resident and parity-clean
+        assert len(list(clusters.standby.tpu.resident.keys())) >= 3
+        assert clusters.standby.tpu.verify_all().ok
+
+    def test_promoted_region_serves_live_traffic_warm(self, warm_clusters):
+        """After the warm flip, the promoted side completes live work on
+        the pre-hydrated state and stays byte-converged with the old
+        active once replication drains back."""
+        clusters = warm_clusters
+        FailoverManager(clusters).managed_failover([DOMAIN])
+        box = clusters.standby
+        poller = TaskPoller(box, DOMAIN, TL,
+                            {"mr-wf-0": SignalDecider(expected_signals=3)})
+        box.frontend.signal_workflow_execution(DOMAIN, "mr-wf-0", "after")
+        poller.drain()
+        domain_id = box.stores.domain.by_name(DOMAIN).domain_id
+        run_id = box.stores.execution.get_current_run_id(
+            domain_id, "mr-wf-0")
+        promoted_ms = box.stores.execution.get_workflow(
+            domain_id, "mr-wf-0", run_id)
+        assert promoted_ms.execution_info.signal_count == 3
+        # reverse stream reconverges the demoted region
+        clusters.replicate_reverse()
+        old_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "mr-wf-0", run_id)
+        assert (payload_row(old_ms) == payload_row(promoted_ms)).all()
+
+    def test_drain_deadline_degrades_to_ndc_not_blocking(self, warm_clusters):
+        """A zero drain budget cannot stop the failover: the batch counts
+        a degraded drain and the flip proceeds (late arrivals reconcile
+        via NDC conflict resolution, which the replicator runs anyway)."""
+        clusters = warm_clusters
+        # in-flight backlog the drain will NOT be given time to move
+        clusters.active.frontend.signal_workflow_execution(
+            DOMAIN, "mr-wf-1", "late")
+        report = FailoverManager(clusters).managed_failover(
+            [DOMAIN], drain_deadline_s=0.0)
+        assert report.ok and report.succeeded == 1
+        assert report.drain_degraded == 1
+        assert clusters.standby.stores.domain.by_name(
+            DOMAIN).active_cluster == "standby"
+        # the late suffix lands after the flip and reconciles cleanly
+        clusters.replicate()
+        assert clusters.standby.tpu.verify_all().ok
+
+    def test_prehydration_failure_never_fails_failover(self, warm_clusters,
+                                                       monkeypatch):
+        clusters = warm_clusters
+        import cadence_tpu.engine.failovermanager as fmod
+        monkeypatch.setattr(
+            fmod, "prehydrate_serving",
+            lambda box: (_ for _ in ()).throw(RuntimeError("hbm gone")))
+        report = FailoverManager(clusters).managed_failover([DOMAIN])
+        assert report.ok and report.succeeded == 1
+        assert report.prehydration is None  # optimization lost, not the flip
+
+
+class TestReplicationSeamFuzz:
+    def test_profile_gates_hold(self):
+        """The ISSUE 17 fuzz profile: replication apply interleaved with
+        live standby signals/resets and NDC promotion; byte-identical
+        cross-region checksums, DLQ-only quarantine, zero divergence."""
+        from cadence_tpu.gen.interleave import replication_interleave_scenario
+        doc = replication_interleave_scenario(seed=7, length=12, poisons=1)
+        assert doc["ok"], doc
+        assert doc["checksums_identical"]
+        assert doc["dlq_exact"] and doc["dlq_depth"] == 1
+        assert doc["replication"]["device_divergence"] == 0
+        assert doc["serving_divergence"] == 0
+
+    @pytest.mark.slow
+    @pytest.mark.fuzz
+    def test_profile_wide(self):
+        from cadence_tpu.gen.interleave import replication_interleave_scenario
+        for seed in (3, 20260806):
+            doc = replication_interleave_scenario(seed=seed, length=48,
+                                                  poisons=2)
+            assert doc["ok"], (seed, doc)
+
+
+@pytest.mark.slow
+@pytest.mark.load
+class TestRegionFailoverWire:
+    def test_region_kill_promote_warm(self):
+        """The gate scenario at smoke size: two wire regions, standard
+        mix on the active, kill -9 every active-region process
+        mid-traffic, promote the standby warm, verify both regions."""
+        from cadence_tpu.loadgen.scenarios import region_failover_scenario
+        doc = region_failover_scenario(duration_s=6.0, num_hosts=2,
+                                       rps=8.0, pool_size=8, workers=8)
+        assert doc["ok"], {k: doc[k] for k in
+                           ("slo", "replication", "failover", "parity",
+                            "verify") if k in doc}
+        assert doc["failover"]["warm_steals"] > 0
+        assert doc["parity"]["serving_divergence"] == 0
+        assert doc["parity"]["replication_device_divergence"] == 0

@@ -261,3 +261,232 @@ class TestStreamingReplay:
             rows, errs = replay_streamed(events, chunk_events=chunk)
             assert (errs == 0).all()
             assert (rows == np.asarray(single)).all(), f"chunk={chunk} diverged"
+
+
+def _open_signal_workflow(clusters, wf, signals=2):
+    """Start `wf` on the active side and leave it OPEN with a few signals
+    applied (closed runs take no device work — the applier invalidates)."""
+    box = clusters.active
+    box.frontend.start_workflow_execution(DOMAIN, wf, "signal", TL)
+    poller = TaskPoller(box, DOMAIN, TL,
+                        {wf: SignalDecider(expected_signals=99)})
+    poller.drain()
+    for i in range(signals):
+        box.frontend.signal_workflow_execution(DOMAIN, wf, f"{wf}-s{i}")
+    poller.drain()
+
+
+class TestDeviceStandbyApply:
+    """ISSUE 17 tentpole 1: the batch processor drains applied histories
+    through the device tier; host replicator stays the sole authority."""
+
+    def test_cold_keys_stay_host_only(self, clusters):
+        """No resident entry and no shipped snapshot: the device twin
+        counts the key cold and the host path remains complete."""
+        from cadence_tpu.utils import metrics as m
+        _open_signal_workflow(clusters, "dev-cold")
+        clusters.replicate()
+        scope = clusters.standby.metrics.snapshot().get(
+            m.SCOPE_REPLICATION, {})
+        assert scope.get(m.M_REPL_DEVICE_COLD, 0) > 0
+        assert scope.get(m.M_REPL_DEVICE_APPLIED, 0) == 0
+        assert scope.get(m.M_REPL_DEVICE_DIVERGENCE, 0) == 0
+        # host state complete regardless
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "dev-cold")
+        a = clusters.active.stores.history.read_events(
+            domain_id, "dev-cold", run_id)
+        s = clusters.standby.stores.history.read_events(
+            domain_id, "dev-cold", run_id)
+        assert [(e.id, e.event_type) for e in a] == \
+               [(e.id, e.event_type) for e in s]
+
+    def test_kill_switch_restores_host_only_path(self, clusters,
+                                                 monkeypatch):
+        """CADENCE_TPU_REPL_DEVICE=0: zero device work, byte-identical
+        host apply."""
+        from cadence_tpu.utils import metrics as m
+        monkeypatch.setenv("CADENCE_TPU_REPL_DEVICE", "0")
+        _open_signal_workflow(clusters, "dev-off")
+        clusters.replicate()
+        scope = clusters.standby.metrics.snapshot().get(
+            m.SCOPE_REPLICATION, {})
+        for name in (m.M_REPL_DEVICE_APPLIED, m.M_REPL_DEVICE_COLD,
+                     m.M_REPL_DEVICE_DIVERGENCE):
+            assert scope.get(name, 0) == 0
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, "dev-off")
+        active_ms = clusters.active.stores.execution.get_workflow(
+            domain_id, "dev-off", run_id)
+        standby_ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, "dev-off", run_id)
+        assert (payload_row(active_ms) == payload_row(standby_ms)).all()
+
+    def test_shipped_snapshot_seeds_device_bulk_apply(self, monkeypatch):
+        """Tentpole 1+2 end to end: the active's serving tier ships
+        snapshot records down the stream; the standby installs them and
+        subsequent drains become device suffix applies, parity-clean."""
+        from cadence_tpu.engine.multicluster import ReplicatedClusters
+        from cadence_tpu.utils import metrics as m
+        monkeypatch.setenv("CADENCE_TPU_SNAPSHOT_MIN_EVENTS", "1")
+        monkeypatch.setenv("CADENCE_TPU_SNAPSHOT_EVERY_EVENTS", "4")
+        clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
+        clusters.active.enable_serving()
+        try:
+            clusters.register_global_domain(DOMAIN)
+            _open_signal_workflow(clusters, "dev-bulk")
+            clusters.active.serving.drain(timeout=30)
+            # the deploy warm-up sweep: force past the due()-defer policy
+            # (timing-dependent at this tiny scale); records still ship
+            # through the same Snapshotter.shipper hook
+            report = clusters.active.tpu.snapshotter().sweep(force=True)
+            assert report.written > 0
+            clusters.replicate()
+            assert clusters.processor.snapshots_installed > 0
+            # more traffic → the next drain rides the installed seed
+            for i in range(3):
+                clusters.active.frontend.signal_workflow_execution(
+                    DOMAIN, "dev-bulk", f"more-{i}")
+            poller = TaskPoller(clusters.active, DOMAIN, TL,
+                                {"dev-bulk": SignalDecider(
+                                    expected_signals=99)})
+            poller.drain()
+            clusters.active.serving.drain(timeout=30)
+            clusters.replicate()
+            scope = clusters.standby.metrics.snapshot().get(
+                m.SCOPE_REPLICATION, {})
+            assert scope.get(m.M_REPL_SNAP_INSTALLED, 0) > 0
+            assert scope.get(m.M_REPL_DEVICE_APPLIED, 0) > 0
+            assert scope.get(m.M_REPL_DEVICE_DIVERGENCE, 0) == 0
+            assert clusters.standby.tpu.verify_all().ok
+        finally:
+            clusters.active.serving.stop()
+
+
+class TestSnapshotShipping:
+    """ISSUE 17 tentpole 2: torn/stale/foreign shipped records are
+    detected, counted, and never installed."""
+
+    def _base_record(self, clusters, wf):
+        import zlib
+
+        import numpy as np
+
+        from cadence_tpu.engine.snapshot import SnapshotRecord, layout_signature
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, wf)
+        key = (domain_id, wf, run_id)
+        blob = b"shipped-state"
+        return key, dict(
+            key=key, batch_count=1,
+            last_batch_crc=0xBAD, events=4, history_size=64, branch=0,
+            payload=np.zeros(8, dtype=np.int64), state_blob=blob,
+            blob_crc=zlib.crc32(blob), interner={},
+            layout=layout_signature(clusters.standby.tpu.layout))
+
+    def test_torn_stale_foreign_ignored(self, clusters):
+        from cadence_tpu.engine.snapshot import SnapshotRecord
+        from cadence_tpu.utils import metrics as m
+        _open_signal_workflow(clusters, "ship-bad")
+        clusters.replicate()
+        key, base = self._base_record(clusters, "ship-bad")
+
+        torn = SnapshotRecord(**{**base, "blob_crc": base["blob_crc"] ^ 1})
+        foreign_ver = SnapshotRecord(**base)
+        foreign_ver.version = 999
+        foreign_lay = SnapshotRecord(**{**base, "layout": (7, 7, 7)})
+        # batch_count 1 <= stored total, boundary CRC wrong → stale
+        stale = SnapshotRecord(**base)
+        for rec in (torn, foreign_ver, foreign_lay, stale):
+            clusters.publisher.publish_snapshot(rec, "primary")
+        clusters.replicate()
+
+        scope = clusters.standby.metrics.snapshot().get(
+            m.SCOPE_REPLICATION, {})
+        assert scope.get(m.M_REPL_SNAP_SHIPPED, 0) == 4
+        assert scope.get(m.M_REPL_SNAP_IGNORED_TORN, 0) == 1
+        assert scope.get(m.M_REPL_SNAP_IGNORED_FOREIGN, 0) == 2
+        assert scope.get(m.M_REPL_SNAP_IGNORED_STALE, 0) == 1
+        assert scope.get(m.M_REPL_SNAP_INSTALLED, 0) == 0
+        assert clusters.processor.snapshots_installed == 0
+        assert clusters.standby.stores.snapshot.get(key) is None
+
+
+class TestDLQObservability:
+    """ISSUE 17 satellite: depth gauge, rollup, and the redrive arm."""
+
+    def _poison(self, clusters, wf):
+        from cadence_tpu.core.codec import serialize_history
+        from cadence_tpu.core.enums import EventType
+        from cadence_tpu.core.events import HistoryBatch, HistoryEvent
+        from cadence_tpu.engine.replication import ReplicationTask
+        domain_id = clusters.active.stores.domain.by_name(DOMAIN).domain_id
+        run_id = clusters.active.stores.execution.get_current_run_id(
+            domain_id, wf)
+        ms = clusters.standby.stores.execution.get_workflow(
+            domain_id, wf, run_id)
+        next_id = ms.execution_info.next_event_id
+        bad = HistoryBatch(domain_id=domain_id, workflow_id=wf,
+                           run_id=run_id, events=[
+            HistoryEvent(id=next_id,
+                         event_type=EventType.ActivityTaskCompleted,
+                         version=1, timestamp=1,
+                         attrs=dict(scheduled_event_id=9999,
+                                    started_event_id=9998))])
+        clusters.publisher.stores.queue.enqueue(
+            "replication",
+            ReplicationTask(domain_id=domain_id, workflow_id=wf,
+                            run_id=run_id, first_event_id=next_id,
+                            next_event_id=next_id + 1, version=1,
+                            events_blob=serialize_history([bad])))
+
+    def test_summary_and_depth_gauge(self, clusters):
+        from cadence_tpu.utils import metrics as m
+        run_echo(clusters, "dlq-obs")
+        clusters.replicate()
+        self._poison(clusters, "dlq-obs")
+        clusters.replicate()
+        summary = clusters.processor.dlq_summary()
+        assert summary["depth"] == 1
+        assert summary["oldest"]["workflow_id"] == "dlq-obs"
+        assert "missing activity" in summary["oldest"]["error"]
+        assert sum(summary["error_classes"].values()) == 1
+        scope = clusters.standby.metrics.snapshot().get(
+            m.SCOPE_REPLICATION, {})
+        assert scope.get(m.M_REPL_DLQ_DEPTH, 0) == 1.0
+
+    def test_redrive_requeues_still_poison(self, clusters):
+        run_echo(clusters, "dlq-re")
+        clusters.replicate()
+        self._poison(clusters, "dlq-re")
+        clusters.replicate()
+        out = clusters.processor.redrive_dlq()
+        assert out == {"read": 1, "redriven": 0, "requeued": 1}
+        assert len(clusters.processor.read_dlq()) == 1
+
+    def test_redrive_clears_healed_entries(self, clusters):
+        """An entry whose task now applies (or dedups) leaves the DLQ."""
+        from cadence_tpu.engine.replication import (
+            REPLICATION_DLQ,
+            DLQEntry,
+        )
+        from cadence_tpu.utils import metrics as m
+        run_echo(clusters, "dlq-heal")
+        clusters.replicate()
+        # quarantine a COPY of an already-applied stream task: on
+        # redrive it dedups cleanly and must not requeue
+        _, applied_task = clusters.publisher.stores.queue.read(
+            "replication", 0, 1)[0]
+        clusters.standby.stores.queue.enqueue(
+            REPLICATION_DLQ, DLQEntry(task=applied_task,
+                                      error="transient: peer flapped"))
+        out = clusters.processor.redrive_dlq()
+        assert out == {"read": 1, "redriven": 1, "requeued": 0}
+        assert clusters.processor.read_dlq() == []
+        scope = clusters.standby.metrics.snapshot().get(
+            m.SCOPE_REPLICATION, {})
+        assert scope.get(m.M_REPL_REDRIVEN, 0) == 1
+        assert scope.get(m.M_REPL_DLQ_DEPTH, 1) == 0.0
