@@ -296,6 +296,40 @@ def main(argv=None) -> int:
                     help="inject a deterministic device-side defect on "
                          "this signal name (harness validation mode); "
                          "default: shrink a REAL parity divergence")
+    # fleet chaos campaign (gen/cluster_chaos.py): seeded fault schedule
+    # against a REAL multi-host wire cluster — SIGKILLs, store kill +
+    # WAL-fsck + relaunch, asymmetric partitions, membership flaps —
+    # gated on fault-free byte-identity, clean fsck, zero parity
+    # divergence, closing verify_all (both regions with --regions 2)
+    fc = fz.add_parser("cluster")
+    fc.add_argument("--seed", type=int, default=20260806)
+    fc.add_argument("--hosts", type=int, default=3)
+    fc.add_argument("--shards", type=int, default=8)
+    fc.add_argument("--workflows", type=int, default=6)
+    fc.add_argument("--signals", type=int, default=2)
+    fc.add_argument("--kills", type=int, default=1,
+                    help="service hosts SIGKILLed mid-traffic")
+    fc.add_argument("--store-kills", type=int, default=0,
+                    help="store-server SIGKILL + fsck + relaunch cycles")
+    fc.add_argument("--partitions", type=int, default=1,
+                    help="asymmetric partition cut+heal pairs")
+    fc.add_argument("--flaps", type=int, default=0,
+                    help="membership flap (SIGSTOP past TTL, SIGCONT) arms")
+    fc.add_argument("--profile", default="steady",
+                    choices=["steady", "storm"])
+    fc.add_argument("--regions", type=int, default=1, choices=[1, 2])
+    fc.add_argument("--shrink", action="store_true",
+                    help="harness-validation mode: shrink the injected "
+                         "kill-then-signal regression to its 1-minimal "
+                         "campaign (no cluster launched)")
+    fc.add_argument("--shrink-on-failure", action="store_true",
+                    help="on a REAL gate failure, ddmin the campaign to "
+                         "a 1-minimal reproducer (expensive: each "
+                         "predicate call is a baseline+chaos pair)")
+    fc.add_argument("--record", action="store_true",
+                    help="write the next CHAOS_r0N.json in CWD")
+    fc.add_argument("--out", default="",
+                    help="explicit trajectory path (implies --record)")
     fp = fz.add_parser("promote")
     fp.add_argument("--name", required=True)
     fp.add_argument("--seed", type=int, required=True)
@@ -896,6 +930,50 @@ def _fuzz_tool(args) -> int:
             doc["ok"] = bool(doc["ok"] and rilv["ok"])
         if args.record or args.out:
             doc["trajectory"] = fuzz_mod.write_fuzz_trajectory(
+                doc, path=args.out or None)
+        _emit(doc)
+        return 0 if doc["ok"] else 1
+
+    if args.cmd == "cluster":
+        from .gen import cluster_chaos as cc
+
+        if args.shrink:
+            # harness-validation arm: prove the campaign shrinker on
+            # the injected kill-then-signal regression, no cluster
+            campaign = cc.build_campaign(
+                args.seed, num_workflows=args.workflows,
+                signals_per_wf=args.signals, num_hosts=args.hosts,
+                kills=max(1, args.kills), store_kills=args.store_kills,
+                partitions=args.partitions, flaps=args.flaps,
+                profile=args.profile)
+            poison = cc.pick_poison_wf(campaign)
+            if poison is None:
+                _emit({"ok": False,
+                       "note": "campaign has no signal after a kill — "
+                               "pick another seed"})
+                return 1
+            report = cc.shrink_campaign(
+                args.seed, cc.injected_regression_predicate(poison),
+                num_workflows=args.workflows,
+                signals_per_wf=args.signals, num_hosts=args.hosts,
+                kills=max(1, args.kills), store_kills=args.store_kills,
+                partitions=args.partitions, flaps=args.flaps,
+                profile=args.profile)
+            minimal = report.reproduce()
+            _emit({"ok": report.shrunk_ops == 2, "poison_wf": poison,
+                   "minimal_ops": [op.as_dict() for op in minimal],
+                   **report.summary()})
+            return 0 if report.shrunk_ops == 2 else 1
+
+        doc = cc.cluster_campaign_scenario(
+            seed=args.seed, num_hosts=args.hosts, num_shards=args.shards,
+            num_workflows=args.workflows, signals_per_wf=args.signals,
+            kills=args.kills, store_kills=args.store_kills,
+            partitions=args.partitions, flaps=args.flaps,
+            profile=args.profile, regions=args.regions,
+            shrink_on_failure=args.shrink_on_failure)
+        if args.record or args.out:
+            doc["trajectory"] = cc.write_chaos_trajectory(
                 doc, path=args.out or None)
         _emit(doc)
         return 0 if doc["ok"] else 1

@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from ..utils import flightrecorder
+from ..utils import metrics as cm
 from ..utils.clock import TimeSource
 from .history_engine import HistoryEngine
 from .membership import HashRing, shard_id_for_workflow
@@ -48,6 +50,9 @@ class ShardController:
         #: can create the engine before ensure_assigned looks, and an
         #: existence check would then suppress the hook forever
         self._acquire_notified: set = set()
+        #: counter sink — rebindable so a ServiceHost's own registry (the
+        #: one its /metrics scrape serves) sees the eviction witness
+        self.metrics = cm.DEFAULT_REGISTRY
         ring.subscribe(self._on_membership_change)
 
     def _default_factory(self, shard: ShardContext) -> HistoryEngine:
@@ -76,6 +81,13 @@ class ShardController:
             if engine is not None and engine.shard.is_closed:
                 del self._engines[shard_id]
                 engine = None
+                # flap-back witness: a deposed context got evicted and is
+                # about to re-acquire — the counter lets chaos campaigns
+                # assert the fence actually fired on a restored host
+                self.metrics.inc(cm.SCOPE_CONTROLLER,
+                                 cm.M_FENCED_EVICTIONS)
+                flightrecorder.emit("shard-fenced-evict", host=self.host,
+                                    shard=shard_id)
             if engine is None:
                 ctx = ShardContext(shard_id, self.host, self.stores)
                 ctx.acquire()

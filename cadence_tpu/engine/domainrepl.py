@@ -11,15 +11,46 @@ at-least-once topic per concern).
 The receiving side recomputes `is_active` from its OWN cluster name, so
 one replicated record serves every consumer (the invariant that makes a
 domain "global": same domain_id, same config, per-cluster activeness).
+
+Conflicts arbitrate on FAILOVER VERSION first (domain/replicationTask
+Executor.go handleDomainUpdateReplicationTask: the record carrying the
+higher failover version is the authority — the split-brain winner),
+with notification version breaking ties WITHIN one failover epoch (the
+config-update ordering). A task carrying a LOWER failover version than
+the local record is the loser region's update arriving after a
+partition heals: it is rejected typed (`StaleDomainUpdate` recorded on
+`stale_rejects`) and counted, never applied — last-writer-wins here
+would let wall-clock arrival order overwrite the arbitration the
+execution tier already enforces (`_Txn.commit`'s version guard).
 """
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Deque, Tuple
 
+from ..utils import metrics as cm
 from .persistence import DomainInfo, EntityNotExistsError
 
 DOMAIN_REPLICATION_QUEUE = "domain-replication"
+
+#: bounded queue of rejected-stale updates kept for operator inspection
+#: (the "queue" half of reject/queue: losers are observable, not silently
+#: dropped — `admin dlq`-style forensics without a second DLQ)
+STALE_KEEP = 64
+
+
+@dataclass(frozen=True)
+class StaleDomainUpdate:
+    """A rejected domain mutation: its failover version lost arbitration
+    against the locally applied record."""
+
+    domain_id: str
+    name: str
+    task_failover_version: int
+    local_failover_version: int
+    task_notification_version: int
+    local_notification_version: int
 
 
 @dataclass(frozen=True)
@@ -62,9 +93,11 @@ class DomainReplicationPublisher:
 
 class DomainReplicationProcessor:
     """Receiving-side consumer (replicationTaskExecutor.Execute): apply
-    register-or-update, recomputing is_active locally; stale tasks
-    (older notification version) are skipped — the queue is
-    at-least-once and replays after recovery."""
+    register-or-update, recomputing is_active locally. Arbitration is
+    failover-version-first (see module docstring): lower failover
+    version → typed+counted reject onto `stale_rejects`; same failover
+    version, notification version not newer → duplicate replay of the
+    at-least-once queue, skipped silently (counted)."""
 
     def __init__(self, source_queue_stores, target_stores,
                  local_cluster: str) -> None:
@@ -78,6 +111,11 @@ class DomainReplicationProcessor:
         #: wire hosts run the task-refresher sweep off it, the analog of
         #: failover_watcher.go reacting to the metadata change)
         self.on_applied = None
+        #: last STALE_KEEP arbitration losers, newest last
+        self.stale_rejects: Deque[StaleDomainUpdate] = deque(
+            maxlen=STALE_KEEP)
+        #: counter sink (a ServiceHost rebinds to its own registry)
+        self.metrics = cm.DEFAULT_REGISTRY
 
     def process_once(self) -> int:
         """Drain the stream to the tail (all pages); returns tasks
@@ -108,12 +146,32 @@ class DomainReplicationProcessor:
             existing = self.target.domain.by_id(task.domain_id)
         except EntityNotExistsError:
             self.target.domain.register(info)
+            self.metrics.inc(cm.SCOPE_REPLICATION, cm.M_DOMREPL_APPLIED)
             if self.on_applied is not None:
                 self.on_applied(task, info.is_active)
             return True
-        if existing.notification_version >= task.notification_version:
-            return False  # stale replay (at-least-once queue)
+        if task.failover_version < existing.failover_version:
+            # arbitration loser: the split-brain standby's update landing
+            # after the winner's — reject typed + counted, NEVER apply
+            # (LWW here would re-activate the deposed region's view)
+            self.stale_rejects.append(StaleDomainUpdate(
+                domain_id=task.domain_id, name=task.name,
+                task_failover_version=task.failover_version,
+                local_failover_version=existing.failover_version,
+                task_notification_version=task.notification_version,
+                local_notification_version=existing.notification_version))
+            self.metrics.inc(cm.SCOPE_REPLICATION,
+                             cm.M_DOMREPL_STALE_REJECTED)
+            return False
+        if (task.failover_version == existing.failover_version
+                and existing.notification_version
+                >= task.notification_version):
+            # duplicate replay within one failover epoch (at-least-once
+            # queue): already applied, advance past it
+            self.metrics.inc(cm.SCOPE_REPLICATION, cm.M_DOMREPL_DUPLICATE)
+            return False
         self.target.domain.update(info)
+        self.metrics.inc(cm.SCOPE_REPLICATION, cm.M_DOMREPL_APPLIED)
         if self.on_applied is not None:
             became_active = (info.is_active
                              and existing.active_cluster != self.local_cluster)

@@ -29,6 +29,10 @@ class HashRing:
         self._ring: List[int] = []
         self._owners: Dict[int, str] = {}
         self._listeners: List[Callable[[], None]] = []
+        #: monotonic change counter: bumps on every effective add/remove,
+        #: so "did the ring move while I looked away?" is one int compare
+        #: (chaos campaigns use it as the membership-flap witness)
+        self._generation = 0
         if members:
             for m in members:
                 self.add_member(m)
@@ -48,6 +52,7 @@ class HashRing:
             if member in self._members:
                 return
             self._members.append(member)
+            self._generation += 1
             self._rebuild()
         self._notify()
 
@@ -56,12 +61,18 @@ class HashRing:
             if member not in self._members:
                 return
             self._members.remove(member)
+            self._generation += 1
             self._rebuild()
         self._notify()
 
     def members(self) -> List[str]:
         with self._lock:
             return list(self._members)
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
 
     def lookup(self, key: str) -> str:
         """Owner of `key` (resolver.go:169 LookupByAddress path)."""

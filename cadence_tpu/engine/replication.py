@@ -51,6 +51,30 @@ ENABLE_DEVICE_ENV = "CADENCE_TPU_REPL_DEVICE"
 SITE_REPL_APPLY = "repl.apply"
 SITE_REPL_ACK = "repl.ack"
 
+#: per-domain backpressure (PR-17 headroom): max tasks ONE domain may
+#: apply in a single process_once pass. After a partition heals, the
+#: ordered queue holds a monolithic flood for the partitioned domain —
+#: without a bound, one drain call applies the whole backlog and the
+#: host's pump tick (timers, transfer, domain + cross-cluster consumers)
+#: starves behind it. 0 disables the bound.
+DOMAIN_BUDGET_ENV = "CADENCE_TPU_REPL_DOMAIN_BUDGET"
+DEFAULT_DOMAIN_BUDGET = 256
+
+
+class ReplicationBackpressureShed(Exception):
+    """Typed shed: a drain pass stopped early because one domain hit its
+    per-pass apply budget. The ack index stops BEFORE the first deferred
+    task, so the next pass resumes exactly there — at-least-once order
+    preserved, service per tick bounded."""
+
+    def __init__(self, domain_id: str, applied: int, deferred: int) -> None:
+        super().__init__(
+            f"replication backpressure: domain {domain_id} hit its "
+            f"per-pass budget ({applied} applied, {deferred} deferred)")
+        self.domain_id = domain_id
+        self.applied = applied
+        self.deferred = deferred
+
 
 def _items_until(items: Tuple[Tuple[int, int], ...], event_id: int
                  ) -> Tuple[Tuple[int, int], ...]:
@@ -717,6 +741,16 @@ class ReplicationTaskProcessor:
         self.deduped = 0
         self.resends = 0
         self.snapshots_installed = 0
+        #: per-pass per-domain apply bound (see DOMAIN_BUDGET_ENV); the
+        #: env default keeps subprocess hosts tunable with zero plumbing
+        try:
+            self.domain_budget = int(
+                os.environ.get(DOMAIN_BUDGET_ENV, DEFAULT_DOMAIN_BUDGET))
+        except ValueError:
+            self.domain_budget = DEFAULT_DOMAIN_BUDGET
+        self.sheds = 0
+        #: the most recent typed shed (None when the last pass ran clean)
+        self.last_shed: Optional[ReplicationBackpressureShed] = None
         self._metrics = m.DEFAULT_REGISTRY
         self.device = _DeviceApplier(tpu, self._metrics)
 
@@ -735,16 +769,41 @@ class ReplicationTaskProcessor:
             return self.replicator.sync_activity(task)
         return self.replicator.apply(task)
 
-    def process_once(self, batch_size: int = 100) -> int:
+    def process_once(self, batch_size: int = 100,
+                     raise_on_shed: bool = False) -> int:
         scope = self.metrics.scope(m.SCOPE_REPLICATION)
         tasks = self.source.read_tasks(self.ack_index, batch_size)
         touched: List[tuple] = []
         seen = set()
-        for index, task in tasks:
+        per_domain: Dict[str, int] = {}
+        self.last_shed = None
+        processed = 0
+        for pos, (index, task) in enumerate(tasks):
+            domain_id = getattr(task, "domain_id", None)
+            if (self.domain_budget > 0 and domain_id is not None
+                    and per_domain.get(domain_id, 0) >= self.domain_budget):
+                # typed shed: stop BEFORE this task (ack stays behind it,
+                # so the ordered queue redelivers next pass) — a heal
+                # flood on one domain yields the tick back to every other
+                # consumer instead of monopolizing it
+                deferred = len(tasks) - pos
+                self.sheds += 1
+                self.last_shed = ReplicationBackpressureShed(
+                    domain_id, per_domain[domain_id], deferred)
+                scope.inc(m.M_REPL_BP_SHED)
+                scope.inc(m.M_REPL_BP_DEFERRED, deferred)
+                flightrecorder.emit("repl-backpressure-shed",
+                                    domain=domain_id,
+                                    applied=per_domain[domain_id],
+                                    deferred=deferred)
+                break
+            if domain_id is not None:
+                per_domain[domain_id] = per_domain.get(domain_id, 0) + 1
             crashpoints.fire(SITE_REPL_APPLY)
             if isinstance(task, ShippedSnapshotTask):
                 self._install_shipped(task, scope)
                 self.ack_index = index + 1
+                processed += 1
                 crashpoints.fire(SITE_REPL_ACK)
                 continue
             try:
@@ -764,10 +823,13 @@ class ReplicationTaskProcessor:
             except ReplayError as err:
                 self._quarantine(task, str(err))
             self.ack_index = index + 1
+            processed += 1
             crashpoints.fire(SITE_REPL_ACK)
         if touched and self.device.enabled():
             self.device.apply_keys(touched)
-        return len(tasks)
+        if self.last_shed is not None and raise_on_shed:
+            raise self.last_shed
+        return processed
 
     def _install_shipped(self, task: ShippedSnapshotTask, scope) -> None:
         """Install one shipped snapshot into the standby's store (tentpole

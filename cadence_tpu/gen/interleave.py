@@ -47,16 +47,23 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..core.checksum import crc32_of_row, payload_row
-from ..core.enums import DecisionType, EventType, WorkflowState
-from ..engine import crashpoints
+from ..core.codec import serialize_history
+from ..core.enums import EMPTY_EVENT_ID, DecisionType, EventType, WorkflowState
+from ..core.events import HistoryBatch, HistoryEvent, RetryPolicy
+from ..engine import crashpoints, walcheck
 from ..engine.crashpoints import CrashPoint, SimulatedCrash
 from ..engine.faults import FaultInjector, TransientStoreError, inject_faults
 from ..engine.domain import DomainNotActiveError
-from ..engine.history_engine import Decision, InvalidRequestError
+from ..engine.durability import open_durable_stores, recover_stores
+from ..engine.history_engine import Decision, InvalidRequestError, TaskToken
+from ..engine.multicluster import ReplicatedClusters
+from ..engine.onebox import Onebox
 from ..engine.persistence import (
     EntityNotExistsError,
     WorkflowAlreadyStartedError,
 )
+from ..engine.replication import ReplicationTask, _DeviceApplier
+from ..models.deciders import SignalDecider
 from ..rpc import chaos as chaos_mod
 from ..rpc.chaos import ChaosError
 from ..utils import metrics as m
@@ -259,9 +266,6 @@ class InterleaveDriver:
     # -- box lifecycle -------------------------------------------------------
 
     def _open_box(self, fresh: bool) -> None:
-        from ..engine.durability import open_durable_stores, recover_stores
-        from ..engine.onebox import Onebox
-
         if fresh and not os.path.exists(self.wal_path):
             stores = open_durable_stores(self.wal_path)
         else:
@@ -291,8 +295,6 @@ class InterleaveDriver:
     def _recover_from_crash(self) -> None:
         """The armed crashpoint fired: the 'process' died mid-commit.
         fsck the surviving WAL (gated clean), recover, rebuild."""
-        from ..engine import walcheck
-
         crashpoints.uninstall()
         self.result.kills += 1
         box, self.box = self.box, None
@@ -497,8 +499,6 @@ class InterleaveDriver:
         fault-free-vs-chaos checksum gate rests on."""
 
         def op(box):
-            from ..core.enums import EMPTY_EVENT_ID
-            from ..engine.history_engine import TaskToken
             domain_id = box.stores.domain.by_name(DOMAIN).domain_id
             run = box.stores.execution.get_current_run_id(domain_id, wf)
             ms = box.stores.execution.get_workflow(domain_id, wf, run)
@@ -532,7 +532,6 @@ class InterleaveDriver:
         matching itself would have used."""
 
         def op(box):
-            from ..engine.history_engine import TaskToken
             domain_id = box.stores.domain.by_name(DOMAIN).domain_id
             run = box.stores.execution.get_current_run_id(domain_id, wf)
             ms = box.stores.execution.get_workflow(domain_id, wf, run)
@@ -563,7 +562,6 @@ class InterleaveDriver:
         op = item["op"]
         wf = item.get("wf", "")
         if op == "start":
-            from ..core.events import RetryPolicy
             retry = (RetryPolicy(initial_interval_seconds=1,
                                  backoff_coefficient=2.0,
                                  maximum_interval_seconds=8,
@@ -898,9 +896,6 @@ class _ReplicationDriver:
     the bumped failover version."""
 
     def __init__(self, seed: int, num_workflows: int = 4) -> None:
-        from ..engine.multicluster import ReplicatedClusters
-        from ..models.deciders import SignalDecider
-
         self.seed = seed
         self.clusters = ReplicatedClusters(num_hosts=1, num_shards=4)
         # the serving tier feeds the seam under test: its post-flush
@@ -971,10 +966,6 @@ class _ReplicationDriver:
         replicator must raise ReplayError and quarantine to the DLQ,
         never half-apply. Crafted after a full drain so the poison is at
         the head of the gap, not deduped behind real traffic."""
-        from ..core.codec import serialize_history
-        from ..core.events import HistoryBatch, HistoryEvent
-        from ..engine.replication import ReplicationTask
-
         self.clusters.replicate()
         run_id = self.clusters.standby.stores.execution.get_current_run_id(
             self.domain_id, wf)
@@ -1152,7 +1143,6 @@ def replication_interleave_scenario(seed: int = 20260806,
     verify_active = c.active.tpu.verify_all()
     verify_standby = c.standby.tpu.verify_all()
 
-    from ..engine.replication import _DeviceApplier
     device_expected = _DeviceApplier(c.standby.tpu,
                                      c.standby.metrics).enabled()
     identical = active_sums == standby_sums

@@ -99,7 +99,8 @@ class Cluster:
                  procs: Dict[str, subprocess.Popen],
                  store_proc: subprocess.Popen,
                  http_ports: Dict[str, int] = None,
-                 spawn_host=None, wal: str = "") -> None:
+                 spawn_host=None, wal: str = "",
+                 store_cmd=None, store_env=None) -> None:
         self.store_port = store_port
         #: WAL path of the store server ("" = in-memory): a killed
         #: region's store can relaunch from it for post-mortem recovery
@@ -113,6 +114,11 @@ class Cluster:
         #: planned-rebalance seam: add_host grows the ring mid-life and
         #: the losing hosts migrate their moving shards' resident state
         self._spawn_host = spawn_host
+        #: exact store-server invocation (argv + env) — kill_store /
+        #: relaunch_store replay it so a WAL-backed store can SIGKILL and
+        #: recover on the SAME port mid-campaign (gen/cluster_chaos.py)
+        self._store_cmd = list(store_cmd) if store_cmd else None
+        self._store_env = dict(store_env) if store_env else None
 
     def frontend(self, index_or_name) -> FrontendClient:
         name = (index_or_name if isinstance(index_or_name, str)
@@ -180,6 +186,59 @@ class Cluster:
 
     def resume_host(self, name: str) -> None:
         self.procs[name].send_signal(signal.SIGCONT)
+
+    def kill_store(self) -> None:
+        """SIGKILL the store-server process mid-traffic. Every host call
+        fails retryably until relaunch_store(); only meaningful with a
+        durable WAL (an in-memory store's state dies with it)."""
+        if self.store_proc.poll() is None:
+            self.store_proc.kill()
+            self.store_proc.wait(timeout=10)
+
+    def relaunch_store(self) -> None:
+        """Respawn the store server with its original argv/env on the
+        SAME port: boot recovery replays the WAL it was killed with
+        (rpc/storeserver.serve → engine/durability.recover_stores), so
+        hosts' pooled connections redial and the fleet resumes. The
+        caller fscks `self.wal` BEFORE calling this when it wants the
+        recovery gated clean (the campaign oracle does)."""
+        if self._store_cmd is None:
+            raise RuntimeError("this cluster was not built by launch()")
+        if self.store_proc.poll() is None:
+            raise RuntimeError("store server still running")
+        self.store_proc = subprocess.Popen(self._store_cmd,
+                                           env=self._store_env)
+        _wait_listening(self.store_port, self.store_proc)
+
+    # -- asymmetric partitions (rpc/chaos.PartitionTable over the wire) ----
+
+    def _endpoint(self, dst: str) -> Tuple[str, int]:
+        """"store" or a host name → the (host, port) its dialers use."""
+        if dst == "store":
+            return ("127.0.0.1", self.store_port)
+        return ("127.0.0.1", self.hosts[dst])
+
+    def sever(self, src: str, dst: str) -> dict:
+        """Block src's OUTBOUND leg to `dst` ("store" or a host name).
+        Asymmetric by construction: dst → src and every other pair keep
+        flowing until severed themselves."""
+        host, port = self._endpoint(dst)
+        return self.admin(src, "admin_partition", "block", host, port)
+
+    def heal(self, src: str, dst: str) -> dict:
+        host, port = self._endpoint(dst)
+        return self.admin(src, "admin_partition", "heal", host, port)
+
+    def heal_all_partitions(self) -> None:
+        """Campaign teardown: clear every live host's partition table so
+        the closing gates (checksums, verify_all) read a healed fleet."""
+        for name in self.hosts:
+            if self.procs[name].poll() is None:
+                try:
+                    self.admin(name, "admin_partition", "heal_all",
+                               timeout=10)
+                except Exception:
+                    pass
 
     def stop(self) -> None:
         for p in self.procs.values():
@@ -461,4 +520,5 @@ def launch(num_hosts: int = 2, num_shards: int = 8, wal: str = "",
             break
         time.sleep(0.05)
     return Cluster(store_port, hosts, procs, store_proc,
-                   http_ports=http_ports, spawn_host=spawn_host, wal=wal)
+                   http_ports=http_ports, spawn_host=spawn_host, wal=wal,
+                   store_cmd=store_cmd, store_env=store_env)

@@ -51,6 +51,7 @@ from ..utils.circuitbreaker import (
 )
 from ..utils.clock import RealTimeSource
 from ..utils.deadline import DeadlineExceeded
+from . import chaos as chaos_mod
 from .client import RemoteEngine, RemoteMatching, RemoteStores
 from .wire import recv_frame, send_frame, verify_hello
 
@@ -284,6 +285,20 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         # (per-domain series appear as domains take traffic)
         self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_ADMITTED, 0)
         self.metrics.inc(cm.SCOPE_QUOTAS, cm.M_QUOTA_SHED, 0)
+        # membership/controller/partition witnesses pre-registered: a
+        # chaos campaign must distinguish "no flap observed" and "no
+        # partition enforced" from "series missing" on every host
+        self.metrics.inc(cm.SCOPE_MEMBERSHIP, cm.M_RING_DROPS, 0)
+        self.metrics.inc(cm.SCOPE_MEMBERSHIP, cm.M_RING_JOINS, 0)
+        self.metrics.gauge(cm.SCOPE_MEMBERSHIP, cm.M_RING_GENERATION, 0.0)
+        self.metrics.inc(cm.SCOPE_CONTROLLER, cm.M_FENCED_EVICTIONS, 0)
+        self.metrics.inc(chaos_mod.SCOPE_PARTITION,
+                         chaos_mod.M_PART_BLOCKED_SENDS, 0)
+        self.metrics.gauge(chaos_mod.SCOPE_PARTITION,
+                           chaos_mod.M_PART_ACTIVE, 0.0)
+        # the process partition table reports into THIS host's registry
+        # (scrapes and admin_metrics see what this host enforces)
+        chaos_mod.partitions().registry = self.metrics
         # device-serving tier series pre-registered (tpu.serving/*): the
         # parity-divergence counter in particular must ALWAYS scrape — a
         # missing series and "zero divergences" must be distinguishable
@@ -359,7 +374,6 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         # subprocess path; an operator override here wins)
         chaos_spec = self.config.get(dc.KEY_WIRE_CHAOS)
         if chaos_spec:
-            from . import chaos as chaos_mod
             chaos_mod.install(chaos_mod.parse_spec(chaos_spec))
         # durability crashpoints ride the same contract (env var for
         # subprocesses, dynamicconfig for operator overrides)
@@ -423,6 +437,7 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         self.controller = ShardController(name, num_shards, self.stores,
                                           self.ring, self.clock,
                                           engine_factory=self._make_engine)
+        self.controller.metrics = self.metrics
         if self.migration is not None:
             self.controller.on_shards_released = \
                 self.migration.shards_released
@@ -517,7 +532,10 @@ class ServiceHost(socketserver.ThreadingTCPServer):
                        cm.M_REPL_SNAP_SHIPPED, cm.M_REPL_SNAP_INSTALLED,
                        cm.M_REPL_SNAP_IGNORED_TORN,
                        cm.M_REPL_SNAP_IGNORED_STALE,
-                       cm.M_REPL_SNAP_IGNORED_FOREIGN):
+                       cm.M_REPL_SNAP_IGNORED_FOREIGN,
+                       cm.M_REPL_BP_SHED, cm.M_REPL_BP_DEFERRED,
+                       cm.M_DOMREPL_APPLIED, cm.M_DOMREPL_STALE_REJECTED,
+                       cm.M_DOMREPL_DUPLICATE):
             self.metrics.inc(cm.SCOPE_REPLICATION, metric, 0)
         self.metrics.gauge(cm.SCOPE_REPLICATION, cm.M_REPL_DLQ_DEPTH, 0.0)
 
@@ -542,6 +560,7 @@ class ServiceHost(socketserver.ThreadingTCPServer):
             repl.metrics = self.metrics
             domain = DomainReplicationProcessor(peer.stores, self.stores,
                                                 self.cluster_name)
+            domain.metrics = self.metrics
             domain.on_applied = self._on_domain_replicated
             xc_peer = _WireCrossClusterProcessor(
                 peer.stores, self.route, self.cluster_name,
@@ -733,10 +752,26 @@ class ServiceHost(socketserver.ThreadingTCPServer):
         if names and names != current:
             # ring changes fire the controller's acquire/release callback
             # (shard/controller.go:381) — the steal path
-            for m in names - current:
+            joined, dropped = names - current, current - names
+            for m in joined:
                 self.ring.add_member(m)
-            for m in current - names:
+            for m in dropped:
                 self.ring.remove_member(m)
+            # flap witnesses: per-host drop/join counters plus a monotonic
+            # ring generation, so a chaos campaign can assert "the fleet
+            # OBSERVED the flap" from /metrics instead of inferring it
+            # from traffic (gen/cluster_chaos.py membership-flap gate)
+            from ..utils import metrics as cm
+            self.metrics.inc(cm.SCOPE_MEMBERSHIP, cm.M_RING_JOINS,
+                             len(joined))
+            self.metrics.inc(cm.SCOPE_MEMBERSHIP, cm.M_RING_DROPS,
+                             len(dropped))
+            self.metrics.gauge(cm.SCOPE_MEMBERSHIP, cm.M_RING_GENERATION,
+                               self.ring.generation)
+            flightrecorder.emit("ring-change", host=self.name,
+                                joined=sorted(joined),
+                                dropped=sorted(dropped),
+                                members=sorted(names))
         # idempotent re-acquisition: a transient store error during an
         # earlier eager acquire must not leave assigned shards engineless
         self.controller.ensure_assigned()
@@ -968,6 +1003,27 @@ class _Handler(socketserver.BaseRequestHandler):
                 result = proc.redrive_dlq()
             else:
                 result = proc.dlq_summary()
+        elif op == "admin_partition":
+            # per-peer-pair partition control (rpc/chaos.PartitionTable):
+            # ("admin_partition", "block"|"heal", host, port) severs or
+            # restores THIS host's outbound leg to one endpoint —
+            # asymmetric by construction, since the reverse direction
+            # lives in the peer's own table; "heal_all" and "list" manage
+            # campaign teardown/inspection. The admin call itself rides
+            # campaign-client → this host, so a host partitioned from
+            # the store stays controllable.
+            sub = req[1] if len(req) > 1 else "list"
+            table = chaos_mod.partitions()
+            if sub == "block":
+                table.block(req[2], int(req[3]))
+            elif sub == "heal":
+                table.heal(req[2], int(req[3]))
+            elif sub == "heal_all":
+                table.heal_all()
+            elif sub != "list":
+                raise ValueError(f"unknown admin_partition arm {sub!r}")
+            result = {"host": server.name, "pairs": table.pairs(),
+                      **table.counts()}
         elif op == "admin_timeseries":
             # the /timeseries doc over the wire (operator tooling that
             # already speaks the protocol need not open the HTTP port)

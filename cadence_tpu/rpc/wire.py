@@ -26,6 +26,7 @@ from typing import Any, Optional, Tuple
 from ..utils import deadline as deadline_mod
 from ..utils import tracing
 from ..utils.deadline import DeadlineExceeded
+from . import chaos as chaos_mod
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -172,7 +173,6 @@ def send_request_frame(sock: socket.socket, obj: Any) -> None:
     `_wire_local`: they happen before any byte reaches the peer, so they
     are neither evidence against the target (breakers must not charge
     them) nor worth a resend of the identical payload."""
-    from . import chaos as chaos_mod
     try:
         header, body = _encode_frame(obj)
     except BaseException as exc:
@@ -227,6 +227,7 @@ def call(address: Tuple[str, int], request: Any, timeout: float = 30.0) -> Any:
     carrying the service-level type (ShardOwnershipLostError & co) across
     the process boundary. An active caller deadline rides the envelope
     and shrinks the socket timeout."""
+    chaos_mod.check_partition(address)
     timeout = effective_timeout(timeout)
     with socket.create_connection(address, timeout=timeout) as sock:
         send_hello(sock)
@@ -259,6 +260,14 @@ class Connection:
 
     def call(self, request: Any) -> Any:
         for attempt in (0, 1):
+            # an installed partition cuts pooled connections too: check
+            # per call (not per dial), close the idle socket so healing
+            # redials fresh, and raise before any byte leaves — the
+            # nothing-was-applied contract ChaosError promises
+            table = chaos_mod.active_partitions()
+            if table is not None and table.is_blocked(self.address):
+                self.close()
+                table.check(self.address)
             # derived per attempt: send-retry time counts against the budget
             timeout = effective_timeout(self.timeout)
             sock = self._ensure(timeout)

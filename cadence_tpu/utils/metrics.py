@@ -106,6 +106,14 @@ SCOPE_FLIGHTREC = "flightrec"
 #: rate; multi-window alerting fires when the SHORT and LONG horizons
 #: both exceed the threshold
 SCOPE_SLO = "slo"
+#: hashring membership as observed by THIS host (rpc/server.py
+#: refresh_membership): drop/join counters plus the ring-generation
+#: gauge — the witnesses chaos campaigns read to prove a membership
+#: flap propagated fleet-wide (gen/cluster_chaos.py)
+SCOPE_MEMBERSHIP = "membership"
+#: shard controller (engine/controller.py): fenced-engine evictions — a
+#: deposed context discarded and re-acquired after a flap-back
+SCOPE_CONTROLLER = "controller"
 
 # -- metric names -----------------------------------------------------------
 
@@ -144,6 +152,26 @@ M_REPL_SNAP_INSTALLED = "snapshots-installed"
 M_REPL_SNAP_IGNORED_TORN = "snapshots-ignored-torn"
 M_REPL_SNAP_IGNORED_STALE = "snapshots-ignored-stale"
 M_REPL_SNAP_IGNORED_FOREIGN = "snapshots-ignored-foreign"
+#: per-domain replication backpressure (engine/replication.py): a drain
+#: pass stops (typed ReplicationBackpressureShed) once one domain has
+#: consumed its per-pass apply budget, so a partition-heal flood on one
+#: domain cannot starve the pump tick for every other domain; -deferred
+#: counts the tasks the shed pass left for the next tick
+M_REPL_BP_SHED = "backpressure-shed"
+M_REPL_BP_DEFERRED = "backpressure-deferred"
+#: domain-metadata failover-version arbitration (engine/domainrepl.py):
+#: applied mutations vs stale ones rejected (lower failover version than
+#: the local record — the split-brain loser's update) vs duplicate
+#: notification replays at the same failover version
+M_DOMREPL_APPLIED = "domain-applied"
+M_DOMREPL_STALE_REJECTED = "domain-stale-rejected"
+M_DOMREPL_DUPLICATE = "domain-duplicate"
+#: membership-flap witnesses (SCOPE_MEMBERSHIP)
+M_RING_DROPS = "ring-drops"
+M_RING_JOINS = "ring-joins"
+M_RING_GENERATION = "ring-generation"
+#: fenced-engine evictions (SCOPE_CONTROLLER)
+M_FENCED_EVICTIONS = "fenced-evictions"
 M_KERNEL_LAUNCHES = "kernel-launches"
 M_EVENTS_REPLAYED = "events-replayed"
 M_REPLAY_THROUGHPUT = "replay-events-per-sec"

@@ -147,3 +147,119 @@ class TestDomainReplication:
         info = c.standby.frontend.describe_domain(DOMAIN)
         assert info.active_cluster == "standby"
         assert info.is_active
+
+
+class TestDomainArbitration:
+    """ISSUE 18 satellite: failover-version-first conflict arbitration
+    replacing last-writer-wins — the loser region's update arriving
+    after a partition heals must be rejected typed + counted, never
+    applied (domain/replicationTaskExecutor.go
+    handleDomainUpdateReplicationTask)."""
+
+    def _processor_and_registry(self, clusters):
+        from cadence_tpu.engine.domainrepl import DomainReplicationProcessor
+        from cadence_tpu.utils.metrics import MetricsRegistry
+
+        proc = DomainReplicationProcessor(clusters.active.stores,
+                                          clusters.standby.stores,
+                                          "standby")
+        proc.metrics = MetricsRegistry()
+        return proc, proc.metrics
+
+    def _task(self, info, **overrides):
+        from cadence_tpu.engine.domainrepl import DomainReplicationTask
+
+        base = DomainReplicationTask.of(info)
+        return DomainReplicationTask(**{**base.__dict__, **overrides})
+
+    def test_lower_failover_version_rejected_typed(self, clusters):
+        from cadence_tpu.utils import metrics as cm
+
+        clusters.replicate_domains()
+        proc, reg = self._processor_and_registry(clusters)
+        local = clusters.standby.stores.domain.by_name(DOMAIN)
+        stale = self._task(local,
+                           failover_version=local.failover_version - 1,
+                           notification_version=local.notification_version
+                           + 99, description="split-brain loser")
+        assert proc._apply(stale) is False
+        # never applied — LWW would have taken the higher notification
+        after = clusters.standby.stores.domain.by_name(DOMAIN)
+        assert after.description == local.description
+        assert after.failover_version == local.failover_version
+        # typed + counted + kept for forensics
+        assert reg.counter(cm.SCOPE_REPLICATION,
+                           cm.M_DOMREPL_STALE_REJECTED) == 1
+        rej = proc.stale_rejects[-1]
+        assert rej.domain_id == local.domain_id
+        assert rej.task_failover_version == local.failover_version - 1
+        assert rej.local_failover_version == local.failover_version
+
+    def test_equal_version_stale_notification_is_duplicate(self, clusters):
+        from cadence_tpu.utils import metrics as cm
+
+        clusters.replicate_domains()
+        proc, reg = self._processor_and_registry(clusters)
+        local = clusters.standby.stores.domain.by_name(DOMAIN)
+        dup = self._task(local, description="queue redelivery")
+        assert proc._apply(dup) is False
+        # a duplicate is NOT an arbitration loser: no stale_rejects entry
+        assert len(proc.stale_rejects) == 0
+        assert reg.counter(cm.SCOPE_REPLICATION,
+                           cm.M_DOMREPL_DUPLICATE) == 1
+        assert reg.counter(cm.SCOPE_REPLICATION,
+                           cm.M_DOMREPL_STALE_REJECTED) == 0
+
+    def test_higher_failover_version_wins_regardless_of_notification(
+            self, clusters):
+        from cadence_tpu.utils import metrics as cm
+
+        clusters.replicate_domains()
+        proc, reg = self._processor_and_registry(clusters)
+        local = clusters.standby.stores.domain.by_name(DOMAIN)
+        winner = self._task(local,
+                            failover_version=local.failover_version + 10,
+                            notification_version=0,
+                            description="new failover epoch")
+        assert proc._apply(winner) is True
+        after = clusters.standby.stores.domain.by_name(DOMAIN)
+        assert after.failover_version == local.failover_version + 10
+        assert after.description == "new failover epoch"
+        assert reg.counter(cm.SCOPE_REPLICATION,
+                           cm.M_DOMREPL_APPLIED) == 1
+
+    def test_stale_rejects_deque_bounded(self, clusters):
+        from cadence_tpu.engine.domainrepl import STALE_KEEP
+
+        clusters.replicate_domains()
+        proc, _ = self._processor_and_registry(clusters)
+        local = clusters.standby.stores.domain.by_name(DOMAIN)
+        stale = self._task(local,
+                           failover_version=local.failover_version - 1)
+        for _ in range(STALE_KEEP + 5):
+            assert proc._apply(stale) is False
+        assert len(proc.stale_rejects) == STALE_KEEP
+
+    def test_healed_partition_replay_keeps_winner(self, clusters):
+        """End-to-end split-brain: after a failover to standby, the old
+        active's queued pre-failover update replays into the standby —
+        and must lose arbitration instead of reverting activeness."""
+        clusters.active.frontend.update_domain(DOMAIN,
+                                               description="pre-failover")
+        clusters.failover(DOMAIN, to_cluster="standby")
+        clusters.replicate_domains()
+        info = clusters.standby.stores.domain.by_name(DOMAIN)
+        assert info.active_cluster == "standby"
+        # replay the whole queue from scratch (the healed partition's
+        # redelivery): the pre-failover update carries the OLD failover
+        # version and must be rejected, not LWW-applied
+        from cadence_tpu.engine.domainrepl import DomainReplicationProcessor
+        replayer = DomainReplicationProcessor(clusters.active.stores,
+                                              clusters.standby.stores,
+                                              "standby")
+        replayer.process_once()
+        after = clusters.standby.stores.domain.by_name(DOMAIN)
+        assert after.active_cluster == "standby"
+        assert after.failover_version == info.failover_version
+        assert any(r.task_failover_version < r.local_failover_version
+                   for r in replayer.stale_rejects)

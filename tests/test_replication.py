@@ -490,3 +490,86 @@ class TestDLQObservability:
             m.SCOPE_REPLICATION, {})
         assert scope.get(m.M_REPL_REDRIVEN, 0) == 1
         assert scope.get(m.M_REPL_DLQ_DEPTH, 1) == 0.0
+
+
+class TestDomainBackpressure:
+    """ISSUE 18 satellite: per-domain apply budget in the replication
+    pump — a healed partition's monolithic one-domain flood sheds
+    (typed, counted, ack NOT advanced past the cut) instead of
+    monopolizing the pump tick."""
+
+    def _flood(self, clusters, signals=8):
+        _open_signal_workflow(clusters, "bp-wf", signals=signals)
+
+    def test_over_budget_pass_sheds_typed_and_resumes(self, clusters):
+        from cadence_tpu.engine.replication import (
+            ReplicationBackpressureShed,
+        )
+        from cadence_tpu.utils import metrics as cm
+
+        self._flood(clusters)
+        proc = clusters.processor
+        backlog = clusters.active.stores.queue.size("replication")
+        assert backlog > 3
+        proc.domain_budget = 2
+        first = proc.process_once()
+        # the pass stopped at the budget: typed shed recorded, ack held
+        assert first <= proc.domain_budget
+        assert proc.sheds == 1
+        assert isinstance(proc.last_shed, ReplicationBackpressureShed)
+        assert proc.last_shed.deferred == backlog - first
+        reg = clusters.standby.metrics
+        assert reg.counter(cm.SCOPE_REPLICATION, cm.M_REPL_BP_SHED) == 1
+        assert reg.counter(cm.SCOPE_REPLICATION,
+                           cm.M_REPL_BP_DEFERRED) == backlog - first
+        # the ordered queue redelivers from the cut: repeated passes
+        # drain the flood completely, nothing lost or reordered
+        total = first
+        for _ in range(backlog):
+            n = proc.process_once()
+            if n == 0 and proc.last_shed is None:
+                break
+            total += n
+        assert total == backlog
+        assert proc.last_shed is None
+        # converged: standby state byte-matches the active
+        wf = "bp-wf"
+        a = clusters.active.stores
+        s = clusters.standby.stores
+        domain_id = a.domain.by_name(DOMAIN).domain_id
+        run = a.execution.get_current_run_id(domain_id, wf)
+        assert np.array_equal(
+            payload_row(a.execution.get_workflow(domain_id, wf, run)),
+            payload_row(s.execution.get_workflow(domain_id, wf, run)))
+
+    def test_raise_on_shed_surfaces_typed_exception(self, clusters):
+        from cadence_tpu.engine.replication import (
+            ReplicationBackpressureShed,
+        )
+
+        self._flood(clusters)
+        proc = clusters.processor
+        proc.domain_budget = 1
+        with pytest.raises(ReplicationBackpressureShed) as exc:
+            proc.process_once(raise_on_shed=True)
+        assert exc.value.applied == 1
+        assert exc.value.deferred >= 1
+
+    def test_zero_budget_disables_the_bound(self, clusters):
+        self._flood(clusters)
+        proc = clusters.processor
+        proc.domain_budget = 0
+        backlog = clusters.active.stores.queue.size("replication")
+        assert proc.process_once() == backlog
+        assert proc.sheds == 0
+        assert proc.last_shed is None
+
+    def test_env_sets_default_budget(self, monkeypatch):
+        from cadence_tpu.engine import replication as repl_mod
+
+        monkeypatch.setenv(repl_mod.DOMAIN_BUDGET_ENV, "7")
+        c = ReplicatedClusters(num_hosts=1, num_shards=4)
+        assert c.processor.domain_budget == 7
+        monkeypatch.setenv(repl_mod.DOMAIN_BUDGET_ENV, "bogus")
+        c2 = ReplicatedClusters(num_hosts=1, num_shards=4)
+        assert c2.processor.domain_budget == repl_mod.DEFAULT_DOMAIN_BUDGET
